@@ -1,0 +1,15 @@
+"""Test-session environment: force an 8-device virtual CPU platform.
+
+Must run before the first `import jax` anywhere in the test session so that
+multi-chip sharding tests (mesh/pjit/shard_map) exercise real 8-way SPMD
+partitioning without TPU hardware.  Mirrors the driver's dryrun_multichip
+environment (xla_force_host_platform_device_count).
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

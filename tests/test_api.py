@@ -1,0 +1,178 @@
+"""API layer tests: defaults, validation, helpers.
+
+Mirrors /root/reference/pkg/apis/tensorflow/v1/defaults_test.go:83-122 and
+pkg/apis/tensorflow/validation/validation_test.go:27.
+"""
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.core import Container, PodTemplateSpec
+from tf_operator_tpu.api.defaults import normalize_replica_type, set_defaults, total_replicas
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    SuccessPolicy,
+    TPUJob,
+    TPUJobSpec,
+    TPUTopology,
+    contains_chief_or_master,
+)
+from tf_operator_tpu.api.validation import ValidationError, validate_spec
+
+from testutil import new_replica_spec, new_tpujob
+
+
+def _raw_job(specs) -> TPUJob:
+    job = TPUJob()
+    job.metadata.name = "j"
+    job.spec = TPUJobSpec(replica_specs=specs)
+    return job
+
+
+class TestDefaults:
+    def test_replicas_default_one(self):
+        spec = ReplicaSpec(
+            template=PodTemplateSpec(containers=[Container(name="tensorflow", image="i")])
+        )
+        job = _raw_job({ReplicaType.WORKER: spec})
+        set_defaults(job)
+        assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 1
+
+    def test_restart_policy_default_never(self):
+        job = _raw_job({ReplicaType.WORKER: ReplicaSpec(
+            replicas=2,
+            template=PodTemplateSpec(containers=[Container(name="tensorflow", image="i")]),
+        )})
+        set_defaults(job)
+        assert job.spec.replica_specs[ReplicaType.WORKER].restart_policy == RestartPolicy.NEVER
+
+    def test_port_injected(self):
+        job = new_tpujob(worker=1)
+        ports = job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].ports
+        assert any(
+            p.name == constants.DEFAULT_PORT_NAME and p.container_port == constants.DEFAULT_PORT
+            for p in ports
+        )
+
+    def test_existing_port_kept(self):
+        from tf_operator_tpu.api.core import ContainerPort
+
+        spec = ReplicaSpec(
+            replicas=1,
+            template=PodTemplateSpec(containers=[Container(
+                name="tensorflow", image="i",
+                ports=[ContainerPort(name=constants.DEFAULT_PORT_NAME, container_port=9999)],
+            )]),
+        )
+        job = _raw_job({ReplicaType.WORKER: spec})
+        set_defaults(job)
+        ports = job.spec.replica_specs[ReplicaType.WORKER].template.containers[0].ports
+        assert len(ports) == 1 and ports[0].container_port == 9999
+
+    def test_replica_type_casing_normalized(self):
+        # (ref: defaults.go:70-89 setTypeNamesToCamelCase)
+        spec = new_replica_spec(1)
+        job = _raw_job({"ps": spec})
+        set_defaults(job)
+        assert ReplicaType.PS in job.spec.replica_specs
+        assert "ps" not in job.spec.replica_specs
+
+    def test_policies_defaulted(self):
+        job = new_tpujob(worker=1)
+        assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.RUNNING
+        assert job.spec.success_policy == SuccessPolicy.DEFAULT
+
+    def test_tpu_resource_injected(self):
+        spec = new_replica_spec(2, tpu=TPUTopology(accelerator="v5litepod-8", topology="2x4"))
+        job = _raw_job({ReplicaType.WORKER: spec})
+        set_defaults(job)
+        c = job.spec.replica_specs[ReplicaType.WORKER].template.containers[0]
+        assert c.resources[constants.TPU_RESOURCE] == 8.0
+
+    def test_min_available_defaults_to_total(self):
+        from tf_operator_tpu.api.types import RunPolicy, SchedulingPolicy
+
+        job = new_tpujob(worker=4, ps=2, defaulted=False)
+        job.spec.run_policy = RunPolicy(scheduling_policy=SchedulingPolicy())
+        set_defaults(job)
+        assert job.spec.run_policy.scheduling_policy.min_available == 6
+
+    def test_total_replicas(self):
+        assert total_replicas(new_tpujob(worker=4, ps=2, chief=1)) == 7
+
+
+class TestValidation:
+    def test_valid(self):
+        validate_spec(new_tpujob(worker=2, ps=1, chief=1).spec)
+
+    def test_empty_replicas_rejected(self):
+        with pytest.raises(ValidationError):
+            validate_spec(TPUJobSpec(replica_specs={}))
+
+    def test_no_containers_rejected(self):
+        spec = ReplicaSpec(replicas=1, template=PodTemplateSpec(containers=[]))
+        with pytest.raises(ValidationError):
+            validate_spec(TPUJobSpec(replica_specs={ReplicaType.WORKER: spec}))
+
+    def test_empty_image_rejected(self):
+        spec = ReplicaSpec(
+            replicas=1,
+            template=PodTemplateSpec(containers=[Container(name="tensorflow", image="")]),
+        )
+        with pytest.raises(ValidationError):
+            validate_spec(TPUJobSpec(replica_specs={ReplicaType.WORKER: spec}))
+
+    def test_wrong_container_name_rejected(self):
+        # (ref: validation.go:47-56 — needs a container named "tensorflow")
+        spec = new_replica_spec(1, container_name="main")
+        with pytest.raises(ValidationError):
+            validate_spec(TPUJobSpec(replica_specs={ReplicaType.WORKER: spec}))
+
+    def test_alt_container_name_accepted(self):
+        spec = new_replica_spec(1, container_name=constants.ALT_CONTAINER_NAME)
+        validate_spec(TPUJobSpec(replica_specs={ReplicaType.WORKER: spec}))
+
+    def test_two_chiefs_rejected(self):
+        with pytest.raises(ValidationError):
+            validate_spec(TPUJobSpec(replica_specs={
+                ReplicaType.CHIEF: new_replica_spec(1),
+                ReplicaType.MASTER: new_replica_spec(1),
+            }))
+
+    def test_two_evaluators_rejected(self):
+        with pytest.raises(ValidationError):
+            validate_spec(TPUJobSpec(replica_specs={
+                ReplicaType.WORKER: new_replica_spec(1),
+                ReplicaType.EVALUATOR: new_replica_spec(2),
+            }))
+
+    def test_unknown_replica_type_rejected(self):
+        with pytest.raises(ValidationError):
+            validate_spec(TPUJobSpec(replica_specs={"Foo": new_replica_spec(1)}))
+
+    def test_bad_mesh_rejected(self):
+        spec = new_replica_spec(1, tpu=TPUTopology(topology="2x4", mesh={"dp": 3}))
+        with pytest.raises(ValidationError):
+            validate_spec(TPUJobSpec(replica_specs={ReplicaType.WORKER: spec}))
+
+    def test_mesh_matching_topology_ok(self):
+        spec = new_replica_spec(1, tpu=TPUTopology(topology="2x4", mesh={"dp": 2, "tp": 4}))
+        validate_spec(TPUJobSpec(replica_specs={ReplicaType.WORKER: spec}))
+
+
+class TestHelpers:
+    def test_normalize(self):
+        assert normalize_replica_type("WORKER") == ReplicaType.WORKER
+        assert normalize_replica_type("Ps") == ReplicaType.PS
+        assert normalize_replica_type("nope") is None
+
+    def test_contains_chief(self):
+        assert contains_chief_or_master(new_tpujob(worker=1, chief=1))
+        assert contains_chief_or_master(new_tpujob(worker=1, master=1))
+        assert not contains_chief_or_master(new_tpujob(worker=1))
+
+    def test_tpu_topology_chips(self):
+        assert TPUTopology(topology="2x4").num_chips() == 8
+        assert TPUTopology(topology="4x4x4").num_chips() == 64

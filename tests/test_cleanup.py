@@ -1,0 +1,168 @@
+"""Terminal-state cleanup + gang scheduling tests.
+
+Mirrors /root/reference/pkg/controller.v1/tensorflow/job_test.go:189
+(TestDeletePodsAndServices), the CleanPodPolicy E2E suite
+(py/kubeflow/tf_operator cleanpod_policy_tests.py semantics), TTL cleanup
+(common/job.go:307-330), and PodGroup lifecycle
+(common/job_controller.go:211-239).
+"""
+import time
+
+from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    JobConditionType,
+    ReplicaType,
+)
+from tf_operator_tpu.runtime import conditions
+from tf_operator_tpu.runtime.cluster import NotFound
+
+from testutil import new_controller, new_pod, new_tpujob
+
+
+def make_succeeded_job(policy):
+    job = new_tpujob(worker=2)
+    job.spec.run_policy.clean_pod_policy = policy
+    conditions.update_job_conditions(
+        job.status, JobConditionType.SUCCEEDED, "TPUJobSucceeded", "done"
+    )
+    job.status.completion_time = time.time()
+    return job
+
+
+class TestCleanPodPolicy:
+    def _run(self, policy):
+        controller, cluster, fake_pods, fake_services = new_controller()
+        job = make_succeeded_job(policy)
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 0, PodPhase.SUCCEEDED, exit_code=0))
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 1, PodPhase.RUNNING))
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        return fake_pods, fake_services
+
+    def test_running(self):
+        # only the running pod deleted (ref: job.go:113-121 + CleanPodPolicy)
+        fake_pods, fake_services = self._run(CleanPodPolicy.RUNNING)
+        assert fake_pods.deleted_pod_names == ["test-tpujob-worker-1"]
+        assert len(fake_services.deleted_service_names) == 0  # none existed
+
+    def test_all(self):
+        fake_pods, _ = self._run(CleanPodPolicy.ALL)
+        assert sorted(fake_pods.deleted_pod_names) == [
+            "test-tpujob-worker-0",
+            "test-tpujob-worker-1",
+        ]
+
+    def test_none(self):
+        fake_pods, _ = self._run(CleanPodPolicy.NONE)
+        assert fake_pods.deleted_pod_names == []
+
+    def test_services_deleted_with_pods(self):
+        controller, cluster, fake_pods, fake_services = new_controller()
+        from tf_operator_tpu.runtime.control import RealPodControl, RealServiceControl
+
+        controller.reconciler.pod_control = RealPodControl(cluster)
+        controller.reconciler.service_control = RealServiceControl(cluster)
+        job = new_tpujob(worker=2)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert len(cluster.list_services()) == 2
+        # finish the job
+        for i in range(2):
+            cluster.set_pod_phase("default", f"test-tpujob-worker-{i}", PodPhase.SUCCEEDED, exit_code=0)
+        controller.sync_job(job.key())  # marks succeeded
+        controller.sync_job(job.key())  # terminal cleanup
+        assert cluster.list_services() == []
+
+
+def test_succeeded_flips_active_to_succeeded():
+    """Terminal sync folds active counts into succeeded (ref: job.go:128-136)."""
+    controller, cluster, _, _ = new_controller()
+    job = make_succeeded_job(CleanPodPolicy.NONE)
+    from tf_operator_tpu.api.types import ReplicaStatus
+
+    job.status.replica_statuses = {"Worker": ReplicaStatus(active=2, succeeded=0)}
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    stored = cluster.get_job("default", "test-tpujob")
+    rs = stored.status.replica_statuses["Worker"]
+    assert (rs.active, rs.succeeded) == (0, 2)
+
+
+class TestTTL:
+    def test_expired_job_deleted(self):
+        controller, cluster, _, _ = new_controller()
+        job = make_succeeded_job(CleanPodPolicy.NONE)
+        job.spec.run_policy.ttl_seconds_after_finished = 1
+        job.status.completion_time = time.time() - 100
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        try:
+            cluster.get_job("default", "test-tpujob")
+            assert False, "job should have been TTL-deleted"
+        except NotFound:
+            pass
+
+    def test_unexpired_job_kept(self):
+        controller, cluster, _, _ = new_controller()
+        job = make_succeeded_job(CleanPodPolicy.NONE)
+        job.spec.run_policy.ttl_seconds_after_finished = 3600
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert cluster.get_job("default", "test-tpujob") is not None
+
+    def test_no_ttl_job_kept(self):
+        controller, cluster, _, _ = new_controller()
+        job = make_succeeded_job(CleanPodPolicy.NONE)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert cluster.get_job("default", "test-tpujob") is not None
+
+
+class TestGangScheduling:
+    def test_podgroup_created_with_min_member(self):
+        controller, cluster, fake_pods, _ = new_controller(enable_gang=True)
+        job = new_tpujob(worker=4, ps=2)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        pg = cluster.get_podgroup("default", "test-tpujob")
+        assert pg.min_member == 6
+        # pods stamped with scheduler name + group annotation
+        # (ref: pod.go:218-231)
+        from tf_operator_tpu.api import constants
+
+        pod = fake_pods.pods[0]
+        assert pod.spec.scheduler_name == constants.GANG_SCHEDULER_NAME
+        assert pod.metadata.annotations[constants.GANG_GROUP_ANNOTATION] == "test-tpujob"
+
+    def test_min_available_override(self):
+        from tf_operator_tpu.api.types import RunPolicy, SchedulingPolicy
+
+        controller, cluster, _, _ = new_controller(enable_gang=True)
+        job = new_tpujob(worker=4)
+        job.spec.run_policy.scheduling_policy = SchedulingPolicy(min_available=3)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert cluster.get_podgroup("default", "test-tpujob").min_member == 3
+
+    def test_podgroup_deleted_on_terminal(self):
+        controller, cluster, _, _ = new_controller(enable_gang=True)
+        job = make_succeeded_job(CleanPodPolicy.NONE)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        try:
+            cluster.get_podgroup("default", "test-tpujob")
+            assert False, "podgroup should be deleted on terminal job"
+        except NotFound:
+            pass
+
+    def test_no_gang_no_podgroup(self):
+        controller, cluster, _, _ = new_controller(enable_gang=False)
+        job = new_tpujob(worker=2)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        try:
+            cluster.get_podgroup("default", "test-tpujob")
+            assert False
+        except NotFound:
+            pass

@@ -1,0 +1,102 @@
+"""In-memory end-to-end: controller thread + watch events + real controls.
+
+The hermetic analogue of the reference's E2E flow (simple_tfjob_tests.py:26-87):
+submit job → pods/services appear → phases flow → conditions transition →
+terminal cleanup. No real processes; pod phases are driven by the test like
+the kubelet would.
+"""
+import time
+
+import pytest
+
+from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.api.types import JobConditionType, ReplicaType
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.runtime import conditions
+from tf_operator_tpu.runtime.cluster import InMemoryCluster
+
+from testutil import new_tpujob
+
+
+def wait_for(predicate, timeout=10.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+@pytest.fixture
+def running_controller():
+    cluster = InMemoryCluster()
+    controller = TPUJobController(cluster, threadiness=2)
+    controller.start()
+    yield cluster, controller
+    controller.stop()
+
+
+def test_full_lifecycle(running_controller):
+    cluster, controller = running_controller
+    job = new_tpujob(worker=2, ps=1)
+    cluster.create_job(job)
+
+    # pods + services created by the reconcile loop
+    assert wait_for(lambda: len(cluster.list_pods()) == 3), "pods not created"
+    assert wait_for(lambda: len(cluster.list_services()) == 3), "services not created"
+
+    # drive to Running
+    for pod in cluster.list_pods():
+        cluster.set_pod_phase(pod.metadata.namespace, pod.metadata.name, PodPhase.RUNNING)
+    assert wait_for(
+        lambda: conditions.is_running(cluster.get_job("default", "test-tpujob").status)
+    ), "job did not reach Running"
+
+    # workers finish → job Succeeded (worker-0 rule covers remaining PS)
+    for pod in cluster.list_pods(selector={"replica-type": "worker"}):
+        cluster.set_pod_phase(pod.metadata.namespace, pod.metadata.name,
+                              PodPhase.SUCCEEDED, exit_code=0)
+    assert wait_for(
+        lambda: conditions.is_succeeded(cluster.get_job("default", "test-tpujob").status)
+    ), "job did not reach Succeeded"
+
+    # terminal cleanup: running PS pod deleted under default CleanPodPolicy
+    assert wait_for(
+        lambda: all(
+            p.status.phase != PodPhase.RUNNING for p in cluster.list_pods()
+        )
+    ), "running pods not cleaned up"
+
+
+def test_failure_lifecycle(running_controller):
+    cluster, controller = running_controller
+    job = new_tpujob(worker=2)
+    cluster.create_job(job)
+    assert wait_for(lambda: len(cluster.list_pods()) == 2)
+    pods = cluster.list_pods()
+    cluster.set_pod_phase("default", pods[0].metadata.name, PodPhase.RUNNING)
+    cluster.set_pod_phase("default", pods[1].metadata.name, PodPhase.FAILED, exit_code=1)
+    assert wait_for(
+        lambda: conditions.is_failed(cluster.get_job("default", "test-tpujob").status)
+    ), "job did not fail"
+
+
+def test_exit_code_restart_lifecycle(running_controller):
+    from tf_operator_tpu.api.types import RestartPolicy
+
+    cluster, controller = running_controller
+    job = new_tpujob(worker=2, restart_policy=RestartPolicy.EXIT_CODE)
+    cluster.create_job(job)
+    assert wait_for(lambda: len(cluster.list_pods()) == 2)
+    # preemption-style SIGKILL on worker 0
+    cluster.set_pod_phase("default", "test-tpujob-worker-0", PodPhase.FAILED, exit_code=137)
+    # pod deleted and recreated fresh (Pending)
+    assert wait_for(
+        lambda: any(
+            p.metadata.name == "test-tpujob-worker-0"
+            and p.status.phase == PodPhase.PENDING
+            for p in cluster.list_pods()
+        )
+    ), "worker-0 was not restarted"
+    stored = cluster.get_job("default", "test-tpujob")
+    assert conditions.has_condition(stored.status, JobConditionType.RESTARTING)

@@ -1,0 +1,191 @@
+"""Reconcile-engine tests: the TestNormalPath table and slice diffing.
+
+Mirrors /root/reference/pkg/controller.v1/tensorflow/controller_test.go:67-334
+(table over worker/PS phase combinations → expected creations/deletions/
+statuses) and pod_test.go:404-552 (TestScaleDown/TestScaleUp).
+"""
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.api.types import JobConditionType, ReplicaType
+from tf_operator_tpu.runtime import conditions
+
+from testutil import new_controller, new_pod, new_tpujob, set_pods
+
+
+def run_sync(controller, cluster, job):
+    cluster.create_job(job)
+    assert controller.sync_job(job.key())
+    return cluster.get_job(job.metadata.namespace, job.metadata.name)
+
+
+# Table: (worker, ps, injected phases per type, expected pod creations,
+#         expected service creations, expected active/succeeded/failed counts)
+# (ref: TestNormalPath cases, controller_test.go:67-334)
+NORMAL_PATH_CASES = [
+    # fresh job: everything created
+    ("4w0p-fresh", 4, 0, {}, 4, 4, (0, 0, 0)),
+    ("4w2p-fresh", 4, 2, {}, 6, 6, (0, 0, 0)),
+    # partially created: remaining pods created (services never injected, so
+    # all of them are created)
+    ("4w2p-partial", 4, 2,
+     {ReplicaType.WORKER: dict(pending=2), ReplicaType.PS: dict(pending=1)},
+     3, 6, (0, 0, 0)),
+    # all running
+    ("4w2p-running", 4, 2,
+     {ReplicaType.WORKER: dict(active=4), ReplicaType.PS: dict(active=2)},
+     0, 6, (6, 0, 0)),
+    # 2 running 2 succeeded workers
+    ("4w0p-mixed", 4, 0, {ReplicaType.WORKER: dict(active=2, succeeded=2)},
+     0, 4, (2, 2, 0)),
+    # all workers succeeded
+    ("4w0p-done", 4, 0, {ReplicaType.WORKER: dict(succeeded=4)}, 0, 4, (0, 4, 0)),
+    # worker failed (restartPolicy Never) → failed counted
+    ("4w0p-failed", 4, 0,
+     {ReplicaType.WORKER: dict(active=3, failed=1)}, 0, 4, (3, 0, 1)),
+    # pending pods don't count as active
+    ("4w0p-pending", 4, 0, {ReplicaType.WORKER: dict(pending=4)}, 0, 4, (0, 0, 0)),
+]
+
+
+@pytest.mark.parametrize(
+    "name,workers,ps,phases,want_pods,want_services,counts",
+    NORMAL_PATH_CASES,
+    ids=[c[0] for c in NORMAL_PATH_CASES],
+)
+def test_normal_path(name, workers, ps, phases, want_pods, want_services, counts):
+    controller, cluster, fake_pods, fake_services = new_controller()
+    job = new_tpujob(worker=workers, ps=ps)
+    for rtype, kwargs in phases.items():
+        set_pods(cluster, job, rtype, **kwargs)
+
+    stored = run_sync(controller, cluster, job)
+
+    assert len(fake_pods.pods) == want_pods, f"{name}: pod creations"
+    assert len(fake_services.services) == want_services, f"{name}: service creations"
+    active, succeeded, failed = counts
+    got = stored.status.replica_statuses
+    got_active = sum(rs.active for rs in got.values())
+    got_succeeded = sum(rs.succeeded for rs in got.values())
+    got_failed = sum(rs.failed for rs in got.values())
+    assert (got_active, got_succeeded, got_failed) == (active, succeeded, failed), name
+
+
+def test_created_pod_shape():
+    controller, cluster, fake_pods, fake_services = new_controller()
+    job = new_tpujob(worker=2, ps=1)
+    run_sync(controller, cluster, job)
+    pod = next(
+        p for p in fake_pods.pods
+        if p.metadata.labels[constants.LABEL_REPLICA_TYPE] == "worker"
+        and p.metadata.labels[constants.LABEL_REPLICA_INDEX] == "0"
+    )
+    assert pod.metadata.name == "test-tpujob-worker-0"
+    assert pod.metadata.labels[constants.LABEL_JOB_NAME] == "test-tpujob"
+    assert pod.metadata.labels[constants.LABEL_GROUP_NAME] == constants.API_GROUP
+    # worker-0 is master role when no chief (ref: controller.go:409-416)
+    assert pod.metadata.labels.get(constants.LABEL_JOB_ROLE) == "master"
+    assert pod.metadata.owner_uid == job.metadata.uid
+    # TF_CONFIG injected for distributed job
+    assert pod.spec.containers[0].get_env(constants.ENV_TF_CONFIG) is not None
+    # services headless with matching selector
+    svc = next(
+        s for s in fake_services.services
+        if s.metadata.labels[constants.LABEL_REPLICA_TYPE] == "worker"
+        and s.metadata.labels[constants.LABEL_REPLICA_INDEX] == "0"
+    )
+    assert svc.cluster_ip == "None"
+    assert svc.ports[0].port == constants.DEFAULT_PORT
+
+
+def test_chief_is_master_role():
+    controller, cluster, fake_pods, _ = new_controller()
+    job = new_tpujob(worker=2, chief=1)
+    run_sync(controller, cluster, job)
+    chief = next(
+        p for p in fake_pods.pods
+        if p.metadata.labels[constants.LABEL_REPLICA_TYPE] == "chief"
+    )
+    worker0 = next(
+        p for p in fake_pods.pods
+        if p.metadata.labels[constants.LABEL_REPLICA_TYPE] == "worker"
+        and p.metadata.labels[constants.LABEL_REPLICA_INDEX] == "0"
+    )
+    assert chief.metadata.labels.get(constants.LABEL_JOB_ROLE) == "master"
+    assert constants.LABEL_JOB_ROLE not in worker0.metadata.labels
+
+
+class TestScale:
+    def test_scale_down(self):
+        # (ref: TestScaleDown, pod_test.go:404-470)
+        controller, cluster, fake_pods, _ = new_controller()
+        job = new_tpujob(worker=2)
+        job.spec.enable_dynamic_worker = True
+        for i in range(4):
+            cluster.create_pod(new_pod(job, ReplicaType.WORKER, i, PodPhase.RUNNING))
+        run_sync(controller, cluster, job)
+        assert sorted(fake_pods.deleted_pod_names) == [
+            "test-tpujob-worker-2",
+            "test-tpujob-worker-3",
+        ]
+        assert fake_pods.pods == []
+
+    def test_scale_up(self):
+        # (ref: TestScaleUp, pod_test.go:472-552)
+        controller, cluster, fake_pods, _ = new_controller()
+        job = new_tpujob(worker=4)
+        job.spec.enable_dynamic_worker = True
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 0, PodPhase.RUNNING))
+        run_sync(controller, cluster, job)
+        created = sorted(p.metadata.name for p in fake_pods.pods)
+        assert created == [
+            "test-tpujob-worker-1",
+            "test-tpujob-worker-2",
+            "test-tpujob-worker-3",
+        ]
+
+    def test_sparse_index_filled(self):
+        # hole at index 1 must be re-created
+        controller, cluster, fake_pods, _ = new_controller()
+        job = new_tpujob(worker=3)
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 0, PodPhase.RUNNING))
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 2, PodPhase.RUNNING))
+        run_sync(controller, cluster, job)
+        assert [p.metadata.name for p in fake_pods.pods] == ["test-tpujob-worker-1"]
+
+
+def test_foreign_pods_ignored():
+    """Pods owned by another job must not be adopted or counted
+    (ref: GetPodsForJob claim semantics, common/pod.go:219-254)."""
+    controller, cluster, fake_pods, _ = new_controller()
+    other = new_tpujob(name="other-job")
+    other.metadata.uid = "other-uid"
+    job = new_tpujob(worker=1)
+    foreign = new_pod(other, ReplicaType.WORKER, 0, PodPhase.RUNNING)
+    cluster.create_pod(foreign)
+    run_sync(controller, cluster, job)
+    # our worker-0 still created; foreign pod untouched
+    assert [p.metadata.name for p in fake_pods.pods] == ["test-tpujob-worker-0"]
+    assert fake_pods.deleted_pod_names == []
+
+
+def test_status_write_guard():
+    """Unchanged status must not be re-written (ref: job.go:248-250)."""
+    controller, cluster, fake_pods, _ = new_controller()
+    job = new_tpujob(worker=1, ps=1)
+    set_pods(cluster, job, ReplicaType.WORKER, active=1)
+    set_pods(cluster, job, ReplicaType.PS, active=1)
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+
+    writes = []
+    original = cluster.update_job_status
+
+    def counting(ns, name, status):
+        writes.append(1)
+        return original(ns, name, status)
+
+    cluster.update_job_status = counting
+    controller.sync_job(job.key())  # identical state → no write
+    assert writes == []

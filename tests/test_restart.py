@@ -1,0 +1,216 @@
+"""Failure / restart state-machine tests.
+
+Mirrors /root/reference/pkg/controller.v1/tensorflow/pod_test.go:259-402
+(TestRestartPolicy, TestExitCode), job_test.go:546-750
+(TestActiveDeadlineSeconds, TestBackoffForOnFailure) and the exit-code
+classifier (vendor/.../util/train/train_util.go:18-53).
+"""
+import time
+
+from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.api.types import JobConditionType, ReplicaType, RestartPolicy
+from tf_operator_tpu.runtime import conditions
+from tf_operator_tpu.runtime.exit_codes import (
+    UNKNOWN_EXIT_CODE,
+    is_retryable_exit_code,
+)
+
+from testutil import new_controller, new_pod, new_tpujob
+
+
+def test_exit_code_classifier():
+    for code in (130, 137, 143, 138):
+        assert is_retryable_exit_code(code), code
+    for code in (1, 2, 126, 127, 128, 139, 255):
+        assert not is_retryable_exit_code(code), code
+
+
+def test_restart_policy_mapping():
+    """ExitCode maps to substrate Never (ref: pod.go:310-317)."""
+    controller, cluster, fake_pods, _ = new_controller()
+    job = new_tpujob(worker=1, ps=1, restart_policy=RestartPolicy.EXIT_CODE)
+    job.spec.replica_specs[ReplicaType.PS].restart_policy = RestartPolicy.ON_FAILURE
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    worker = next(p for p in fake_pods.pods if "worker" in p.metadata.name)
+    ps = next(p for p in fake_pods.pods if "-ps-" in p.metadata.name)
+    assert worker.spec.restart_policy == "Never"
+    assert ps.spec.restart_policy == "OnFailure"
+
+
+class TestExitCodeRestart:
+    def _run(self, exit_code):
+        controller, cluster, fake_pods, _ = new_controller()
+        job = new_tpujob(worker=2, restart_policy=RestartPolicy.EXIT_CODE)
+        cluster.create_pod(
+            new_pod(job, ReplicaType.WORKER, 0, PodPhase.FAILED, exit_code=exit_code)
+        )
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 1, PodPhase.RUNNING))
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        stored = cluster.get_job(job.metadata.namespace, job.metadata.name)
+        return stored, fake_pods
+
+    def test_retryable_code_deletes_pod_and_does_not_fail_job(self):
+        # (ref: pod.go:135-154 + TestExitCode pod_test.go:317-402).  The
+        # sibling worker is Running, so Running supersedes Restarting in the
+        # final conditions — but the in-flight restart must suppress JobFailed
+        # (divergence note in controller/status.py).
+        job, fake_pods = self._run(130)
+        assert fake_pods.deleted_pod_names == ["test-tpujob-worker-0"]
+        assert not conditions.is_failed(job.status)
+        assert conditions.is_running(job.status)
+
+    def test_retryable_code_sole_worker_sets_restarting(self):
+        # 1-worker shape of the reference's TestExitCode: no Running sibling,
+        # Restarting survives the pass.
+        controller, cluster, fake_pods, _ = new_controller()
+        job = new_tpujob(worker=1, restart_policy=RestartPolicy.EXIT_CODE)
+        cluster.create_pod(
+            new_pod(job, ReplicaType.WORKER, 0, PodPhase.FAILED, exit_code=130)
+        )
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        stored = cluster.get_job("default", "test-tpujob")
+        assert fake_pods.deleted_pod_names == ["test-tpujob-worker-0"]
+        assert conditions.has_condition(stored.status, JobConditionType.RESTARTING)
+        assert not conditions.is_failed(stored.status)
+
+    def test_tpu_gang_restart(self):
+        """A retryable failure on a TPU-slice replica restarts the whole gang
+        (TPU-native behavior, SURVEY.md §7 hard parts)."""
+        from tf_operator_tpu.api.types import TPUTopology
+
+        controller, cluster, fake_pods, _ = new_controller()
+        job = new_tpujob(worker=4, restart_policy=RestartPolicy.EXIT_CODE)
+        job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+            accelerator="v5litepod-8", topology="2x4"
+        )
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 0, PodPhase.FAILED, exit_code=143))
+        for i in (1, 2, 3):
+            cluster.create_pod(new_pod(job, ReplicaType.WORKER, i, PodPhase.RUNNING))
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert sorted(fake_pods.deleted_pod_names) == [
+            f"test-tpujob-worker-{i}" for i in range(4)
+        ]
+        stored = cluster.get_job("default", "test-tpujob")
+        assert not conditions.is_failed(stored.status)
+
+    def test_permanent_code_fails_job(self):
+        job, fake_pods = self._run(1)
+        assert fake_pods.deleted_pod_names == []
+        assert conditions.is_failed(job.status)
+
+    def test_recreated_after_restart_delete(self):
+        """Second sync after the failed pod is gone recreates index 0."""
+        controller, cluster, _, _ = new_controller()
+        # use real controls for this one
+        from tf_operator_tpu.runtime.control import RealPodControl, RealServiceControl
+
+        controller.reconciler.pod_control = RealPodControl(cluster)
+        controller.reconciler.service_control = RealServiceControl(cluster)
+        job = new_tpujob(worker=2, restart_policy=RestartPolicy.EXIT_CODE)
+        cluster.create_job(job)
+        controller.sync_job(job.key())  # creates pods
+        cluster.set_pod_phase("default", "test-tpujob-worker-0", PodPhase.FAILED, exit_code=137)
+        cluster.set_pod_phase("default", "test-tpujob-worker-1", PodPhase.RUNNING)
+        controller.sync_job(job.key())  # deletes failed pod (restart cycle)
+        stored = cluster.get_job("default", "test-tpujob")
+        assert not conditions.is_failed(stored.status)
+        controller.sync_job(job.key())  # recreates index 0
+        names = sorted(p.metadata.name for p in cluster.list_pods())
+        assert names == ["test-tpujob-worker-0", "test-tpujob-worker-1"]
+
+
+def test_unknown_exit_code_failed_pod():
+    """Failed pod without terminated state reads as 0xbeef → permanent."""
+    controller, cluster, fake_pods, _ = new_controller()
+    job = new_tpujob(worker=1, restart_policy=RestartPolicy.EXIT_CODE)
+    pod = new_pod(job, ReplicaType.WORKER, 0, PodPhase.FAILED)
+    pod.status.container_statuses = []
+    cluster.create_pod(pod)
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    stored = cluster.get_job("default", "test-tpujob")
+    assert not is_retryable_exit_code(UNKNOWN_EXIT_CODE)
+    assert conditions.is_failed(stored.status)
+
+
+class TestBackoffLimit:
+    def test_on_failure_restarts_exceeding_backoff_fail_job(self):
+        # (ref: TestBackoffForOnFailure job_test.go:687; PastBackoffLimit
+        # common/job.go:268-305)
+        controller, cluster, fake_pods, _ = new_controller()
+        job = new_tpujob(worker=2, restart_policy=RestartPolicy.ON_FAILURE)
+        job.spec.run_policy.backoff_limit = 3
+        for i in range(2):
+            pod = new_pod(job, ReplicaType.WORKER, i, PodPhase.RUNNING, restart_count=2)
+            cluster.create_pod(pod)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        stored = cluster.get_job("default", "test-tpujob")
+        assert conditions.is_failed(stored.status)
+        failed = conditions.get_condition(stored.status, JobConditionType.FAILED)
+        assert failed.reason == "BackoffLimitExceeded"
+
+    def test_under_backoff_ok(self):
+        controller, cluster, _, _ = new_controller()
+        job = new_tpujob(worker=2, restart_policy=RestartPolicy.ON_FAILURE)
+        job.spec.run_policy.backoff_limit = 5
+        for i in range(2):
+            cluster.create_pod(new_pod(job, ReplicaType.WORKER, i, PodPhase.RUNNING, restart_count=2))
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        stored = cluster.get_job("default", "test-tpujob")
+        assert not conditions.is_failed(stored.status)
+
+    def test_never_policy_restarts_dont_count(self):
+        # (ref: job.go:281-287 — only Always/OnFailure count)
+        controller, cluster, _, _ = new_controller()
+        job = new_tpujob(worker=1, restart_policy=RestartPolicy.NEVER)
+        job.spec.run_policy.backoff_limit = 0
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 0, PodPhase.RUNNING, restart_count=10))
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        stored = cluster.get_job("default", "test-tpujob")
+        assert not conditions.is_failed(stored.status)
+
+
+class TestActiveDeadline:
+    def test_past_deadline_fails_job(self):
+        # (ref: TestActiveDeadlineSeconds job_test.go:546)
+        controller, cluster, _, _ = new_controller()
+        job = new_tpujob(worker=1)
+        job.spec.run_policy.active_deadline_seconds = 1.0
+        job.status.start_time = time.time() - 10
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        stored = cluster.get_job("default", "test-tpujob")
+        assert conditions.is_failed(stored.status)
+        failed = conditions.get_condition(stored.status, JobConditionType.FAILED)
+        assert failed.reason == "DeadlineExceeded"
+
+    def test_deadline_not_reached(self):
+        controller, cluster, _, _ = new_controller()
+        job = new_tpujob(worker=1)
+        job.spec.run_policy.active_deadline_seconds = 3600.0
+        job.status.start_time = time.time()
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        stored = cluster.get_job("default", "test-tpujob")
+        assert not conditions.is_failed(stored.status)
+
+    def test_deadline_failure_deletes_pods(self):
+        controller, cluster, fake_pods, _ = new_controller()
+        job = new_tpujob(worker=2)
+        job.spec.run_policy.active_deadline_seconds = 1.0
+        job.status.start_time = time.time() - 10
+        for i in range(2):
+            cluster.create_pod(new_pod(job, ReplicaType.WORKER, i, PodPhase.RUNNING))
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert sorted(fake_pods.deleted_pod_names) == [
+            "test-tpujob-worker-0",
+            "test-tpujob-worker-1",
+        ]

@@ -1,0 +1,146 @@
+"""Workqueue, expectations, metrics, and admission primitives."""
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.api.types import JobConditionType
+from tf_operator_tpu.runtime import conditions
+from tf_operator_tpu.runtime.expectations import Expectations, expectation_key
+from tf_operator_tpu.runtime.workqueue import RateLimitingQueue, ShutDown
+from tf_operator_tpu.utils.metrics import REGISTRY, jobs_created
+
+from testutil import new_controller, new_tpujob
+
+
+class TestWorkQueue:
+    def test_dedup_while_queued(self):
+        q = RateLimitingQueue()
+        q.add("a")
+        q.add("a")
+        q.add("b")
+        assert len(q) == 2
+
+    def test_redeliver_if_added_during_processing(self):
+        q = RateLimitingQueue()
+        q.add("a")
+        key = q.get()
+        q.add("a")  # while processing
+        assert len(q) == 0  # not redelivered yet
+        q.done(key)
+        assert q.get(timeout=1) == "a"
+
+    def test_add_after(self):
+        q = RateLimitingQueue()
+        q.add_after("a", 0.05)
+        with pytest.raises(TimeoutError):
+            q.get(timeout=0.01)
+        assert q.get(timeout=1) == "a"
+
+    def test_rate_limit_backoff_grows(self):
+        q = RateLimitingQueue(base_delay=0.01)
+        q.add_rate_limited("a")
+        assert q.num_requeues("a") == 1
+        q.add_rate_limited("a")
+        assert q.num_requeues("a") == 2
+        q.forget("a")
+        assert q.num_requeues("a") == 0
+
+    def test_shutdown_unblocks(self):
+        q = RateLimitingQueue()
+        result = {}
+
+        def worker():
+            try:
+                q.get()
+            except ShutDown:
+                result["shutdown"] = True
+
+        t = threading.Thread(target=worker)
+        t.start()
+        time.sleep(0.05)
+        q.shutdown()
+        t.join(timeout=1)
+        assert result.get("shutdown")
+
+
+class TestExpectations:
+    def test_satisfied_when_empty(self):
+        e = Expectations()
+        assert e.satisfied("k")
+
+    def test_unsatisfied_until_observed(self):
+        e = Expectations()
+        e.expect_creations("k", 2)
+        assert not e.satisfied("k")
+        e.creation_observed("k")
+        assert not e.satisfied("k")
+        e.creation_observed("k")
+        assert e.satisfied("k")
+
+    def test_deletions(self):
+        e = Expectations()
+        e.expect_deletions("k", 1)
+        assert not e.satisfied("k")
+        e.deletion_observed("k")
+        assert e.satisfied("k")
+
+    def test_raise_and_delete(self):
+        e = Expectations()
+        e.raise_expectations("k", adds=1, dels=1)
+        assert not e.satisfied("k")
+        e.delete_expectations("k")
+        assert e.satisfied("k")
+
+    def test_key_format(self):
+        assert expectation_key("ns/job", "Worker", "pods") == "ns/job/worker/pods"
+
+
+class TestMetrics:
+    def test_counter_and_render(self):
+        before = jobs_created.value()
+        jobs_created.labels().inc()
+        assert jobs_created.value() == before + 1
+        text = REGISTRY.render()
+        assert "# TYPE tpu_operator_jobs_created_total counter" in text
+
+
+class TestAdmission:
+    def test_invalid_job_gets_failed_condition(self):
+        # (ref: addTFJob failure path, job.go:65-105)
+        controller, cluster, _, _ = new_controller()
+        job = new_tpujob(defaulted=False)  # no replicas at all → invalid
+        cluster.create_job(job)
+        stored = cluster.get_job("default", "test-tpujob")
+        assert conditions.is_failed(stored.status)
+        events = cluster.list_events(object_name="test-tpujob")
+        assert any(e.reason == "FailedValidation" for e in events)
+
+    def test_valid_job_gets_created_condition(self):
+        controller, cluster, _, _ = new_controller()
+        job = new_tpujob(worker=1)
+        cluster.create_job(job)
+        stored = cluster.get_job("default", "test-tpujob")
+        assert conditions.has_condition(stored.status, JobConditionType.CREATED)
+
+    def test_expectations_gate_blocks_stale_sync(self):
+        """A sync while creations are in flight must be a no-op
+        (ref: controller.go:319)."""
+        controller, cluster, fake_pods, _ = new_controller()
+        job = new_tpujob(worker=2)
+        cluster.create_job(job)
+        assert controller.sync_job(job.key())
+        n = len(fake_pods.pods)
+        assert n == 2
+        # fake control created no real pods → no ADDED events → expectations
+        # still unsatisfied → next sync gated
+        assert not controller.sync_job(job.key())
+        assert len(fake_pods.pods) == n  # no duplicates
+
+    def test_dynamic_worker_bypasses_gate(self):
+        controller, cluster, fake_pods, _ = new_controller()
+        job = new_tpujob(worker=2)
+        job.spec.enable_dynamic_worker = True
+        cluster.create_job(job)
+        assert controller.sync_job(job.key())
+        assert controller.sync_job(job.key())  # gate bypassed

@@ -1,0 +1,177 @@
+"""Status-matrix tests.
+
+Mirrors /root/reference/pkg/controller.v1/tensorflow/status_test.go:40-96
+(TestFailed + ~20 TestStatus sub-cases): the chief/worker-0/AllWorkers success
+rules and the restarting-vs-failed exclusion.
+"""
+from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.api.types import (
+    JobConditionType,
+    ReplicaType,
+    SuccessPolicy,
+)
+from tf_operator_tpu.runtime import conditions
+
+from testutil import new_controller, new_tpujob, set_pods
+
+
+def sync(controller, cluster, job):
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    return cluster.get_job(job.metadata.namespace, job.metadata.name)
+
+
+def cond_types(job):
+    return {c.type for c in job.status.conditions if c.status}
+
+
+class TestChiefRules:
+    def test_chief_running_job_running(self):
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=2, chief=1)
+        set_pods(cluster, job, ReplicaType.CHIEF, active=1)
+        set_pods(cluster, job, ReplicaType.WORKER, active=2)
+        job = sync(controller, cluster, job)
+        assert conditions.is_running(job.status)
+        assert not conditions.is_finished(job.status)
+
+    def test_chief_succeeded_job_succeeded_even_with_running_workers(self):
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=2, chief=1)
+        set_pods(cluster, job, ReplicaType.CHIEF, succeeded=1)
+        set_pods(cluster, job, ReplicaType.WORKER, active=2)
+        job = sync(controller, cluster, job)
+        assert conditions.is_succeeded(job.status)
+        assert job.status.completion_time is not None
+
+    def test_worker_completion_does_not_finish_chief_job(self):
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=2, chief=1)
+        set_pods(cluster, job, ReplicaType.CHIEF, active=1)
+        set_pods(cluster, job, ReplicaType.WORKER, succeeded=2)
+        job = sync(controller, cluster, job)
+        assert not conditions.is_finished(job.status)
+        assert conditions.is_running(job.status)
+
+    def test_master_counts_as_chief(self):
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=1, master=1)
+        set_pods(cluster, job, ReplicaType.MASTER, succeeded=1)
+        job = sync(controller, cluster, job)
+        assert conditions.is_succeeded(job.status)
+
+
+class TestWorkerRules:
+    def test_all_workers_succeeded(self):
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=3)
+        set_pods(cluster, job, ReplicaType.WORKER, succeeded=3)
+        job = sync(controller, cluster, job)
+        assert conditions.is_succeeded(job.status)
+
+    def test_worker0_succeeded_default_policy(self):
+        from testutil import new_pod
+
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=3)
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 0, PodPhase.SUCCEEDED, exit_code=0))
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 1, PodPhase.RUNNING))
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 2, PodPhase.RUNNING))
+        job = sync(controller, cluster, job)
+        assert conditions.is_succeeded(job.status)
+
+    def test_worker0_succeeded_all_workers_policy_not_finished(self):
+        from testutil import new_pod
+
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=3)
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 0, PodPhase.SUCCEEDED, exit_code=0))
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 1, PodPhase.RUNNING))
+        cluster.create_pod(new_pod(job, ReplicaType.WORKER, 2, PodPhase.RUNNING))
+        job = sync(controller, cluster, job)
+        assert not conditions.is_succeeded(job.status)
+        assert conditions.is_running(job.status)
+
+    def test_workers_running(self):
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=2)
+        set_pods(cluster, job, ReplicaType.WORKER, active=2)
+        job = sync(controller, cluster, job)
+        assert conditions.is_running(job.status)
+
+
+class TestFailureRules:
+    def test_worker_failed_job_failed(self):
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=2)
+        set_pods(cluster, job, ReplicaType.WORKER, active=1, failed=1)
+        job = sync(controller, cluster, job)
+        assert conditions.is_failed(job.status)
+        assert job.status.completion_time is not None
+
+    def test_ps_failed_job_failed(self):
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=2, ps=2)
+        set_pods(cluster, job, ReplicaType.WORKER, active=2)
+        set_pods(cluster, job, ReplicaType.PS, active=1, failed=1)
+        job = sync(controller, cluster, job)
+        assert conditions.is_failed(job.status)
+
+    def test_same_pass_restart_suppresses_failed(self):
+        # (ref: status.go:168-195 — restart cycle owns the status; ours is
+        # per-sync, see divergence note in controller/status.py)
+        from tf_operator_tpu.api.types import RestartPolicy
+
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=2, restart_policy=RestartPolicy.EXIT_CODE)
+        set_pods(cluster, job, ReplicaType.WORKER, active=1, failed=1, failed_exit_code=137)
+        job = sync(controller, cluster, job)
+        assert not conditions.is_failed(job.status)
+
+    def test_stale_restarting_condition_does_not_mask_permanent_failure(self):
+        # A lingering Restarting condition from an earlier cycle must not
+        # swallow a new permanent failure (divergence from the reference,
+        # which would wedge here).
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=2)
+        conditions.update_job_conditions(
+            job.status, JobConditionType.RESTARTING, "JobRestarting", "restarting"
+        )
+        set_pods(cluster, job, ReplicaType.WORKER, active=1, failed=1, failed_exit_code=1)
+        job = sync(controller, cluster, job)
+        assert conditions.is_failed(job.status)
+
+    def test_start_time_set(self):
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=1)
+        job = sync(controller, cluster, job)
+        assert job.status.start_time is not None
+
+
+class TestConditionSemantics:
+    def test_running_replaces_restarting(self):
+        job = new_tpujob(worker=1)
+        conditions.update_job_conditions(
+            job.status, JobConditionType.RESTARTING, "r", "m"
+        )
+        conditions.update_job_conditions(job.status, JobConditionType.RUNNING, "r2", "m2")
+        types = [c.type for c in job.status.conditions]
+        assert JobConditionType.RESTARTING not in types
+        assert JobConditionType.RUNNING in types
+
+    def test_terminal_flips_running_false(self):
+        job = new_tpujob(worker=1)
+        conditions.update_job_conditions(job.status, JobConditionType.RUNNING, "r", "m")
+        conditions.update_job_conditions(job.status, JobConditionType.SUCCEEDED, "s", "m")
+        running = conditions.get_condition(job.status, JobConditionType.RUNNING)
+        assert running is not None and running.status is False
+        assert conditions.is_succeeded(job.status)
+
+    def test_transition_time_preserved(self):
+        job = new_tpujob(worker=1)
+        conditions.update_job_conditions(job.status, JobConditionType.RUNNING, "r", "m")
+        t1 = conditions.get_condition(job.status, JobConditionType.RUNNING).last_transition_time
+        conditions.update_job_conditions(job.status, JobConditionType.RUNNING, "r", "m2")
+        t2 = conditions.get_condition(job.status, JobConditionType.RUNNING).last_transition_time
+        assert t1 == t2

@@ -1,0 +1,134 @@
+"""Topology/TF_CONFIG generator tests.
+
+Mirrors /root/reference/pkg/controller.v1/tensorflow/pod_test.go:106-160
+(TestClusterSpec): exact expected TF_CONFIG JSON, custom cluster domain,
+sparse dynamic-worker variant, non-distributed skip — plus the TPU-native
+coordination env that has no reference analogue.
+"""
+import json
+
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.types import ReplicaType
+from tf_operator_tpu.api.types import TPUTopology
+from tf_operator_tpu.controller import topology
+
+from testutil import new_pod, new_tpujob
+
+
+@pytest.fixture(autouse=True)
+def _clear_domain(monkeypatch):
+    monkeypatch.delenv(constants.ENV_CUSTOM_CLUSTER_DOMAIN, raising=False)
+
+
+def test_cluster_spec_addresses():
+    job = new_tpujob(worker=2, ps=1)
+    spec = topology.gen_cluster_spec(job)
+    assert spec == {
+        "worker": [
+            "test-tpujob-worker-0.default.svc:2222",
+            "test-tpujob-worker-1.default.svc:2222",
+        ],
+        "ps": ["test-tpujob-ps-0.default.svc:2222"],
+    }
+
+
+def test_custom_cluster_domain(monkeypatch):
+    # (ref: pod_test.go TestClusterSpec custom domain cases)
+    monkeypatch.setenv(constants.ENV_CUSTOM_CLUSTER_DOMAIN, "cluster.local")
+    job = new_tpujob(worker=1)
+    spec = topology.gen_cluster_spec(job)
+    assert spec["worker"] == ["test-tpujob-worker-0.default.svc.cluster.local:2222"]
+
+
+def test_tf_config_dense():
+    job = new_tpujob(worker=2, ps=1)
+    cfg = json.loads(topology.gen_tf_config(job, ReplicaType.WORKER, 1))
+    assert cfg == {
+        "cluster": {
+            "worker": [
+                "test-tpujob-worker-0.default.svc:2222",
+                "test-tpujob-worker-1.default.svc:2222",
+            ],
+            "ps": ["test-tpujob-ps-0.default.svc:2222"],
+        },
+        "task": {"type": "worker", "index": 1},
+        "environment": "cloud",
+    }
+
+
+def test_tf_config_sparse_worker():
+    # (ref: tensorflow.go:64-84 SparseClusterSpec — worker sees self + all PS)
+    job = new_tpujob(worker=3, ps=2)
+    job.spec.enable_dynamic_worker = True
+    cfg = json.loads(topology.gen_tf_config(job, ReplicaType.WORKER, 2))
+    assert cfg == {
+        "sparseCluster": {
+            "worker": {"2": "test-tpujob-worker-2.default.svc:2222"},
+            "ps": [
+                "test-tpujob-ps-0.default.svc:2222",
+                "test-tpujob-ps-1.default.svc:2222",
+            ],
+        },
+        "task": {"type": "worker", "index": 2},
+    }
+
+
+def test_tf_config_sparse_ps():
+    job = new_tpujob(worker=1, ps=2)
+    job.spec.enable_dynamic_worker = True
+    cfg = json.loads(topology.gen_tf_config(job, ReplicaType.PS, 1))
+    assert cfg["sparseCluster"]["ps"] == ["test-tpujob-ps-1.default.svc:2222"]
+    assert cfg["sparseCluster"]["worker"] == {}
+
+
+def test_non_distributed_no_tf_config():
+    # (ref: pod.go:256-258 / isDistributed:287-308)
+    job = new_tpujob(worker=1)
+    pod = new_pod(job, ReplicaType.WORKER, 0)
+    topology.set_cluster_spec(job, pod, ReplicaType.WORKER, 0)
+    assert pod.spec.containers[0].get_env(constants.ENV_TF_CONFIG) is None
+    # but the TPU env is still present (process identity is useful solo)
+    assert pod.spec.containers[0].get_env(constants.ENV_REPLICA_TYPE) == "worker"
+
+
+def test_distributed_injects_tf_config():
+    job = new_tpujob(worker=2)
+    pod = new_pod(job, ReplicaType.WORKER, 0)
+    topology.set_cluster_spec(job, pod, ReplicaType.WORKER, 0)
+    cfg = json.loads(pod.spec.containers[0].get_env(constants.ENV_TF_CONFIG))
+    assert cfg["task"] == {"type": "worker", "index": 0}
+
+
+class TestTPUEnv:
+    def test_coordinator_is_chief_when_present(self):
+        job = new_tpujob(worker=2, chief=1)
+        env = topology.gen_tpu_env(job, ReplicaType.WORKER, 1)
+        assert env[constants.ENV_COORDINATOR_ADDRESS] == "test-tpujob-chief-0.default.svc:2222"
+        # chief=0, worker0=1, worker1=2
+        assert env[constants.ENV_PROCESS_ID] == "2"
+        assert env[constants.ENV_NUM_PROCESSES] == "3"
+
+    def test_coordinator_is_worker0_without_chief(self):
+        job = new_tpujob(worker=4)
+        env = topology.gen_tpu_env(job, ReplicaType.WORKER, 0)
+        assert env[constants.ENV_COORDINATOR_ADDRESS] == "test-tpujob-worker-0.default.svc:2222"
+        assert env[constants.ENV_PROCESS_ID] == "0"
+        assert env[constants.ENV_NUM_PROCESSES] == "4"
+
+    def test_ps_gets_no_process_id(self):
+        job = new_tpujob(worker=2, ps=1)
+        env = topology.gen_tpu_env(job, ReplicaType.PS, 0)
+        assert constants.ENV_PROCESS_ID not in env
+        assert env[constants.ENV_NUM_PROCESSES] == "2"
+
+    def test_mesh_and_accelerator_injected(self):
+        job = new_tpujob(worker=2)
+        job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+            accelerator="v5litepod-8", topology="2x4", mesh={"dp": 2, "tp": 4}
+        )
+        env = topology.gen_tpu_env(job, ReplicaType.WORKER, 0)
+        assert env[constants.ENV_ACCELERATOR] == "v5litepod-8"
+        assert env[constants.ENV_SLICE_TOPOLOGY] == "2x4"
+        assert json.loads(env[constants.ENV_MESH_SHAPE]) == {"dp": 2, "tp": 4}

@@ -1,0 +1,148 @@
+"""Test fixtures: TPUJob/pod/service builders.
+
+Analogue of the reference's testutil package
+(/root/reference/pkg/common/util/v1/testutil/ — tfjob.go, pod.go, service.go):
+builders for jobs with chosen replica maps, direct pod-state injection into
+the in-memory cluster (the indexer-injection pattern, testutil/pod.go:67-95),
+and a controller wired to fake controls (controller_test.go:45-66).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.core import (
+    Container,
+    ContainerStatus,
+    ObjectMeta,
+    Pod,
+    PodPhase,
+    PodStatus,
+    PodTemplateSpec,
+)
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.types import (
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+    TPUTopology,
+)
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.runtime.cluster import InMemoryCluster
+from tf_operator_tpu.runtime.control import FakePodControl, FakeServiceControl
+from tf_operator_tpu.runtime.reconciler import gen_general_name, gen_labels
+
+TEST_JOB_NAME = "test-tpujob"
+TEST_NAMESPACE = "default"
+TEST_IMAGE = "test-image:latest"
+
+
+def new_replica_spec(
+    replicas: int,
+    restart_policy: RestartPolicy = RestartPolicy.NEVER,
+    tpu: Optional[TPUTopology] = None,
+    container_name: str = constants.DEFAULT_CONTAINER_NAME,
+) -> ReplicaSpec:
+    return ReplicaSpec(
+        replicas=replicas,
+        restart_policy=restart_policy,
+        tpu=tpu,
+        template=PodTemplateSpec(
+            containers=[Container(name=container_name, image=TEST_IMAGE)]
+        ),
+    )
+
+
+def new_tpujob(
+    worker: int = 0,
+    ps: int = 0,
+    chief: int = 0,
+    master: int = 0,
+    evaluator: int = 0,
+    name: str = TEST_JOB_NAME,
+    namespace: str = TEST_NAMESPACE,
+    restart_policy: RestartPolicy = RestartPolicy.NEVER,
+    defaulted: bool = True,
+) -> TPUJob:
+    """(ref: testutil/tfjob.go NewTFJob)"""
+    specs: Dict[ReplicaType, ReplicaSpec] = {}
+    for rtype, count in (
+        (ReplicaType.WORKER, worker),
+        (ReplicaType.PS, ps),
+        (ReplicaType.CHIEF, chief),
+        (ReplicaType.MASTER, master),
+        (ReplicaType.EVALUATOR, evaluator),
+    ):
+        if count > 0:
+            specs[rtype] = new_replica_spec(count, restart_policy)
+    job = TPUJob(
+        metadata=ObjectMeta(name=name, namespace=namespace, uid="tpujob-test-uid"),
+        spec=TPUJobSpec(replica_specs=specs),
+    )
+    if defaulted:
+        set_defaults(job)
+    return job
+
+
+def new_pod(job: TPUJob, rtype: ReplicaType, index: int, phase: PodPhase = PodPhase.PENDING,
+            exit_code: Optional[int] = None, restart_count: int = 0) -> Pod:
+    """(ref: testutil/pod.go NewPod)"""
+    labels = gen_labels(job.metadata.name)
+    labels[constants.LABEL_REPLICA_TYPE] = rtype.value.lower()
+    labels[constants.LABEL_REPLICA_INDEX] = str(index)
+    cs = ContainerStatus(
+        name=constants.DEFAULT_CONTAINER_NAME,
+        running=phase == PodPhase.RUNNING,
+        terminated=exit_code is not None,
+        exit_code=exit_code,
+        restart_count=restart_count,
+    )
+    return Pod(
+        metadata=ObjectMeta(
+            name=gen_general_name(job.metadata.name, rtype.value, index),
+            namespace=job.metadata.namespace,
+            labels=labels,
+            owner_kind=job.kind,
+            owner_name=job.metadata.name,
+            owner_uid=job.metadata.uid,
+        ),
+        spec=PodTemplateSpec(
+            containers=[Container(name=constants.DEFAULT_CONTAINER_NAME, image=TEST_IMAGE)]
+        ),
+        status=PodStatus(phase=phase, container_statuses=[cs]),
+    )
+
+
+def set_pods(cluster: InMemoryCluster, job: TPUJob, rtype: ReplicaType,
+             pending: int = 0, active: int = 0, succeeded: int = 0, failed: int = 0,
+             failed_exit_code: int = 1, restart_counts=None) -> None:
+    """Inject pods in chosen phases (ref: SetPodsStatuses, testutil/pod.go:67-95)."""
+    index = 0
+    for phase, count, exit_code in (
+        (PodPhase.PENDING, pending, None),
+        (PodPhase.RUNNING, active, None),
+        (PodPhase.SUCCEEDED, succeeded, 0),
+        (PodPhase.FAILED, failed, failed_exit_code),
+    ):
+        for _ in range(count):
+            rc = restart_counts[index] if restart_counts else 0
+            pod = new_pod(job, rtype, index, phase, exit_code, restart_count=rc)
+            cluster.create_pod(pod)
+            index += 1
+
+
+def new_controller(enable_gang: bool = False):
+    """Controller wired to fakes (ref: controller_test.go:45-66)."""
+    from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+
+    cluster = InMemoryCluster()
+    controller = TPUJobController(
+        cluster, config=ReconcilerConfig(enable_gang_scheduling=enable_gang)
+    )
+    fake_pods = FakePodControl()
+    fake_services = FakeServiceControl()
+    controller.reconciler.pod_control = fake_pods
+    controller.reconciler.service_control = fake_services
+    return controller, cluster, fake_pods, fake_services

@@ -1,0 +1,25 @@
+"""tf_operator_tpu — a TPU-native distributed-training job framework.
+
+A brand-new re-architecture (not a port) of the capabilities of Kubeflow's
+tf-operator (reference: /root/reference): a TPUJob resource + reconciling
+controller that turns a declarative replica map into gang-scheduled pods on
+Cloud TPU slices, injects cluster topology (TF_CONFIG + JAX coordination env),
+and drives the Created→Running→Restarting→Succeeded/Failed state machine —
+plus the TPU-side training runtime (JAX/XLA/pallas) the reference delegates to
+user containers: SPMD meshes, data/tensor/sequence parallelism, ring
+attention, and reference workloads (MNIST, ResNet-50, BERT, Transformer LM).
+
+Layer map (mirrors SURVEY.md §1):
+  api/        — TPUJob types, defaults, validation   (ref: pkg/apis/tensorflow/v1)
+  runtime/    — generic job reconcile engine          (ref: vendor kubeflow/common)
+  controller/ — TPUJob-specific reconciler + topology (ref: pkg/controller.v1/tensorflow)
+  server/     — flags, metrics, leader election       (ref: cmd/tf-operator.v1)
+  sdk/        — Python client                         (ref: sdk/python/kubeflow/tfjob)
+  parallel/   — meshes, shardings, collectives, ring attention (TPU-native, no ref analogue)
+  ops/        — pallas kernels + jax fallbacks
+  models/     — MNIST / ResNet-50 / BERT / Transformer LM
+  train/      — sharded train-step/trainer machinery
+  workloads/  — runnable pod entrypoints (the "user container" side)
+"""
+
+__version__ = "0.1.0"

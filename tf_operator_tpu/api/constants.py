@@ -1,0 +1,61 @@
+"""API-level constants for the TPUJob resource.
+
+TPU-native re-design of the reference's constants
+(/root/reference/pkg/apis/tensorflow/v1/constants.go:21-34 and
+vendor/github.com/kubeflow/common/pkg/apis/common/v1/constants.go:3-18).
+"""
+
+# --- Group / version / kind (ref: pkg/apis/tensorflow/v1/register.go:31-44) ---
+API_GROUP = "tpu-operator.dev"
+API_VERSION = "v1"
+KIND = "TPUJob"
+PLURAL = "tpujobs"
+SINGULAR = "tpujob"
+CRD_NAME = f"{PLURAL}.{API_GROUP}"
+
+# --- Container / port contract ---
+# The operator acts on exactly one container per pod template.  For drop-in
+# parity with reference TFJobs the default name is "tensorflow"
+# (ref: pkg/apis/tensorflow/v1/constants.go:23-25); "tpu" is accepted as an
+# alias for native jobs.
+DEFAULT_CONTAINER_NAME = "tensorflow"
+ALT_CONTAINER_NAME = "tpu"
+# Port the framework injects if the user declares none
+# (ref: constants.go:27-31 — name "tfjob-port", port 2222).
+DEFAULT_PORT_NAME = "tpujob-port"
+DEFAULT_PORT = 2222
+
+# --- Well-known labels stamped on pods/services ---
+# (ref: vendor/.../apis/common/v1/constants.go:3-18)
+LABEL_GROUP_NAME = "group-name"
+LABEL_JOB_NAME = "job-name"
+LABEL_REPLICA_TYPE = "replica-type"
+LABEL_REPLICA_INDEX = "replica-index"
+LABEL_JOB_ROLE = "job-role"
+JOB_ROLE_MASTER = "master"
+
+# --- Gang scheduling ---
+# (ref: vendor/.../controller.v1/common/pod.go:42-53,472-488)
+GANG_SCHEDULER_NAME = "tpu-gang"
+GANG_GROUP_ANNOTATION = "scheduling.tpu-operator.dev/group-name"
+
+# --- Environment variables the controller injects into pods ---
+# TF_CONFIG is kept byte-compatible with the reference
+# (ref: pkg/controller.v1/tensorflow/tensorflow.go:39-61).
+ENV_TF_CONFIG = "TF_CONFIG"
+# JAX / TPU coordination env (the TPU-native topology document; no reference
+# analogue — the reference only speaks TF_CONFIG).
+ENV_COORDINATOR_ADDRESS = "TPUJOB_COORDINATOR_ADDRESS"
+ENV_PROCESS_ID = "TPUJOB_PROCESS_ID"
+ENV_NUM_PROCESSES = "TPUJOB_NUM_PROCESSES"
+ENV_MESH_SHAPE = "TPUJOB_MESH_SHAPE"  # json dict axis->size, e.g. {"dp":2,"tp":4}
+ENV_SLICE_TOPOLOGY = "TPUJOB_SLICE_TOPOLOGY"  # e.g. "2x4" chips
+ENV_ACCELERATOR = "TPUJOB_ACCELERATOR"  # e.g. "v5litepod-8"
+ENV_REPLICA_TYPE = "TPUJOB_REPLICA_TYPE"
+ENV_REPLICA_INDEX = "TPUJOB_REPLICA_INDEX"
+# Override for the cluster DNS domain appended to service addresses
+# (ref: pkg/controller.v1/tensorflow/tensorflow.go:30-33,160-163).
+ENV_CUSTOM_CLUSTER_DOMAIN = "CUSTOM_CLUSTER_DOMAIN"
+
+# --- Resource names ---
+TPU_RESOURCE = "google.com/tpu"  # replaces nvidia.com/gpu in the reference examples

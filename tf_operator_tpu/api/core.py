@@ -1,0 +1,186 @@
+"""Minimal "core v1" object model: Pods, Services, Events, object metadata.
+
+The reference operates on Kubernetes core-v1 objects via client-go.  This
+framework is cluster-agnostic: the controller reconciles against the small
+object model below through a ClusterInterface seam (runtime/cluster.py), with
+backends that are in-memory (unit tests — the analogue of the reference's
+fake clients, /root/reference/pkg/common/util/v1/testutil/), real local
+processes (hermetic E2E + single-host TPU runs), or a real cluster.
+
+Only the fields the reconcile engine actually reads/writes are modelled;
+everything else passes through `extra` untouched (the reference's
+PodTemplateSpec-passthrough philosophy, tf_job_design_doc.md §TFJob Resource).
+"""
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+
+class PodPhase(str, Enum):
+    """Mirror of k8s core-v1 pod phases the reconciler branches on."""
+
+    PENDING = "Pending"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+    UNKNOWN = "Unknown"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    # Owner reference: (kind, name, uid) of the controlling TPUJob, used for
+    # adoption/orphaning (ref: vendor/.../control/controller_ref_manager.go).
+    owner_kind: str = ""
+    owner_name: str = ""
+    owner_uid: str = ""
+    creation_timestamp: float = field(default_factory=time.time)
+    deletion_timestamp: Optional[float] = None
+
+    def controlled_by(self, kind: str, uid: str) -> bool:
+        return self.owner_kind == kind and self.owner_uid == uid
+
+
+@dataclass
+class EnvVar:
+    name: str
+    value: str
+
+
+@dataclass
+class ContainerPort:
+    name: str = ""
+    container_port: int = 0
+
+
+@dataclass
+class Container:
+    """One container of a pod template.
+
+    `resources` is a flat {resource_name: quantity} map; the TPU resource is
+    constants.TPU_RESOURCE (the reference's examples request nvidia.com/gpu,
+    e.g. examples/v1/distribution_strategy/keras-API/multi_worker_tfjob.yaml).
+    """
+
+    name: str
+    image: str = ""
+    command: List[str] = field(default_factory=list)
+    args: List[str] = field(default_factory=list)
+    env: List[EnvVar] = field(default_factory=list)
+    ports: List[ContainerPort] = field(default_factory=list)
+    resources: Dict[str, float] = field(default_factory=dict)
+
+    def set_env(self, name: str, value: str) -> None:
+        for e in self.env:
+            if e.name == name:
+                e.value = value
+                return
+        self.env.append(EnvVar(name=name, value=value))
+
+    def get_env(self, name: str) -> Optional[str]:
+        for e in self.env:
+            if e.name == name:
+                return e.value
+        return None
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    containers: List[Container] = field(default_factory=list)
+    # "Never" | "Always" | "OnFailure" — what the substrate does on container
+    # exit; set by the controller from the replica RestartPolicy
+    # (ref: pkg/controller.v1/tensorflow/pod.go:310-317).
+    restart_policy: str = ""
+    scheduler_name: str = ""
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    extra: Dict[str, Any] = field(default_factory=dict)  # volumes, affinity, ... passthrough
+
+    def container(self, *names: str) -> Optional[Container]:
+        for c in self.containers:
+            if c.name in names:
+                return c
+        return None
+
+
+@dataclass
+class ContainerStatus:
+    name: str
+    restart_count: int = 0
+    running: bool = False
+    terminated: bool = False
+    exit_code: Optional[int] = None
+
+
+@dataclass
+class PodStatus:
+    phase: PodPhase = PodPhase.PENDING
+    container_statuses: List[ContainerStatus] = field(default_factory=list)
+    start_time: Optional[float] = None
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    def deepcopy(self) -> "Pod":
+        return copy.deepcopy(self)
+
+
+@dataclass
+class ServicePort:
+    name: str = ""
+    port: int = 0
+
+
+@dataclass
+class Service:
+    """Headless-service analogue: a stable DNS name for one replica
+    (ref: vendor/.../controller.v1/common/service.go:303-317)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+    cluster_ip: str = "None"  # headless
+
+
+@dataclass
+class Event:
+    """K8s-Event analogue emitted on the TPUJob (ref: record.EventRecorder
+    usage, e.g. pkg/controller.v1/tensorflow/pod.go:131,146)."""
+
+    object_kind: str
+    object_name: str
+    namespace: str
+    event_type: str  # "Normal" | "Warning"
+    reason: str
+    message: str
+    timestamp: float = field(default_factory=time.time)
+
+
+@dataclass
+class PodGroup:
+    """Gang-scheduling unit: all-or-nothing admission of `min_member` pods.
+
+    TPU-native semantics: a multi-host slice is inherently a gang — partial
+    host sets are useless — so one PodGroup == one slice allocation
+    (ref: Volcano PodGroup sync, vendor/.../common/job_controller.go:211-239).
+    """
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    min_member: int = 0
+    queue: str = ""
+    # Filled by the scheduler/slice-allocator: "Pending" | "Inqueue" | "Running"
+    phase: str = "Pending"

@@ -1,0 +1,107 @@
+"""Defaulting for TPUJob specs.
+
+Behavioral contract of the reference's SetDefaults_TFJob
+(/root/reference/pkg/apis/tensorflow/v1/defaults.go:92-113):
+  - replica-type keys normalized to canonical casing ("ps" → "PS", defaults.go:70-89)
+  - replicas default 1 (defaults.go:28-33)
+  - restartPolicy default Never (defaults.go:61-67)
+  - the framework port is injected on the operator container if the user
+    declared no port with the well-known name (defaults.go:36-58)
+  - cleanPodPolicy default Running, successPolicy default "" (defaults.go:98-104)
+
+TPU additions: scheduling_policy.min_available defaults to the total replica
+count (full-gang), and a replica with a TPU topology gets the slice chip
+count as its google.com/tpu resource request.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from . import constants
+from .core import ContainerPort
+from .types import (
+    CleanPodPolicy,
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    SuccessPolicy,
+    TPUJob,
+)
+
+DEFAULT_RESTART_POLICY = RestartPolicy.NEVER
+
+_CANONICAL = {rt.value.lower(): rt for rt in ReplicaType}
+
+
+def normalize_replica_type(name: str) -> Optional[ReplicaType]:
+    """Case-insensitive replica-type lookup (ref: defaults.go:70-89)."""
+    if isinstance(name, ReplicaType):
+        return name
+    return _CANONICAL.get(str(name).lower())
+
+
+def set_defaults_replica(spec: ReplicaSpec) -> None:
+    if spec.replicas is None:
+        spec.replicas = 1
+    if spec.restart_policy is None:
+        spec.restart_policy = DEFAULT_RESTART_POLICY
+    _set_default_port(spec)
+    _set_default_tpu_resources(spec)
+
+
+def _set_default_port(spec: ReplicaSpec) -> None:
+    """Inject the framework port on the operator container unless the user
+    already declared one with the well-known name (ref: defaults.go:36-58)."""
+    container = spec.template.container(
+        constants.DEFAULT_CONTAINER_NAME, constants.ALT_CONTAINER_NAME
+    )
+    if container is None:
+        return
+    for port in container.ports:
+        if port.name == constants.DEFAULT_PORT_NAME:
+            return
+    container.ports.append(
+        ContainerPort(name=constants.DEFAULT_PORT_NAME, container_port=constants.DEFAULT_PORT)
+    )
+
+
+def _set_default_tpu_resources(spec: ReplicaSpec) -> None:
+    """A replica that declares a TPU topology implicitly requests that many
+    chips (the reference's examples hand-write nvidia.com/gpu requests)."""
+    if spec.tpu is None or not spec.tpu.topology:
+        return
+    container = spec.template.container(
+        constants.DEFAULT_CONTAINER_NAME, constants.ALT_CONTAINER_NAME
+    )
+    if container is not None and constants.TPU_RESOURCE not in container.resources:
+        container.resources[constants.TPU_RESOURCE] = float(spec.tpu.num_chips())
+
+
+def set_defaults(job: TPUJob) -> TPUJob:
+    """Default a TPUJob in place and return it (ref: defaults.go:92-113)."""
+    spec = job.spec
+    if spec.success_policy is None:
+        spec.success_policy = SuccessPolicy.DEFAULT
+    if spec.run_policy.clean_pod_policy is None:
+        spec.run_policy.clean_pod_policy = CleanPodPolicy.RUNNING
+
+    # Normalize replica-type keys (accepts raw strings of any casing).
+    normalized = {}
+    for key, rspec in list(spec.replica_specs.items()):
+        canonical = normalize_replica_type(key)
+        normalized[canonical if canonical is not None else key] = rspec
+    spec.replica_specs = normalized
+
+    for rspec in spec.replica_specs.values():
+        set_defaults_replica(rspec)
+
+    if spec.run_policy.scheduling_policy is not None:
+        sp = spec.run_policy.scheduling_policy
+        if sp.min_available is None:
+            sp.min_available = total_replicas(job)
+    return job
+
+
+def total_replicas(job: TPUJob) -> int:
+    """(ref: vendor/.../util/k8sutil/k8sutil.go GetTotalReplicas)"""
+    return sum(int(r.replicas or 0) for r in job.spec.replica_specs.values())

@@ -1,0 +1,225 @@
+"""TPUJob resource types.
+
+TPU-native re-design of the reference's API layer:
+  - TFJob / TFJobSpec           ref: pkg/apis/tensorflow/v1/types.go:27-68
+  - replica types               ref: types.go:73-92
+  - shared job types            ref: vendor/github.com/kubeflow/common/pkg/apis/common/v1/types.go:24-201
+  - SuccessPolicy               ref: pkg/apis/tensorflow/v1/common.go:17-23
+
+New over the reference: a first-class TPU topology block on each replica spec
+(accelerator type + slice topology + logical mesh), because on TPUs the
+scheduling unit is the slice, not the individual device.
+"""
+from __future__ import annotations
+
+import copy
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Dict, List, Optional
+
+from .core import ObjectMeta, PodTemplateSpec
+
+
+class ReplicaType(str, Enum):
+    """Replica roles (ref: pkg/apis/tensorflow/v1/types.go:73-92).
+
+    PS/Chief/Master/Worker/Evaluator are kept for drop-in parity; on the TPU
+    path Worker pods are TPU-slice hosts and Chief doubles as the JAX
+    distributed coordinator.
+    """
+
+    PS = "PS"
+    WORKER = "Worker"
+    CHIEF = "Chief"
+    MASTER = "Master"
+    EVALUATOR = "Evaluator"
+
+
+# Fixed iteration order for status computation: the reference iterates
+# Chief, Evaluator, Master, PS, Worker (ref: status.go:88-94 — Go map
+# iteration is randomized so the reference sorts; order matters because the
+# chief rule must win before the worker rule runs).
+REPLICA_TYPE_ORDER = [
+    ReplicaType.CHIEF,
+    ReplicaType.EVALUATOR,
+    ReplicaType.MASTER,
+    ReplicaType.PS,
+    ReplicaType.WORKER,
+]
+
+
+class RestartPolicy(str, Enum):
+    """(ref: vendor/.../apis/common/v1/types.go:94-106)"""
+
+    ALWAYS = "Always"
+    ON_FAILURE = "OnFailure"
+    NEVER = "Never"
+    # Restart decision made from the container exit code by the controller
+    # (retryable codes → delete+recreate the pod; ref: types.go:103-105 and
+    # util/train/train_util.go:18-53).
+    EXIT_CODE = "ExitCode"
+
+
+class CleanPodPolicy(str, Enum):
+    """What to do with pods when the job reaches a terminal state
+    (ref: vendor/.../apis/common/v1/types.go:137-146)."""
+
+    ALL = "All"
+    RUNNING = "Running"
+    NONE = "None"
+
+
+class SuccessPolicy(str, Enum):
+    """(ref: pkg/apis/tensorflow/v1/common.go:17-23)"""
+
+    DEFAULT = ""  # chief (if present) or worker-0 completion marks success
+    ALL_WORKERS = "AllWorkers"
+
+
+class JobConditionType(str, Enum):
+    """(ref: vendor/.../apis/common/v1/types.go:107-133)"""
+
+    CREATED = "Created"
+    RUNNING = "Running"
+    RESTARTING = "Restarting"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+@dataclass
+class JobCondition:
+    """(ref: vendor/.../apis/common/v1/types.go:45-63)"""
+
+    type: JobConditionType
+    status: bool  # k8s ConditionStatus True/False collapsed to a bool
+    reason: str = ""
+    message: str = ""
+    last_update_time: float = field(default_factory=time.time)
+    last_transition_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class ReplicaStatus:
+    """(ref: vendor/.../apis/common/v1/types.go:65-77)"""
+
+    active: int = 0
+    succeeded: int = 0
+    failed: int = 0
+
+
+@dataclass
+class JobStatus:
+    """(ref: vendor/.../apis/common/v1/types.go:24-43)"""
+
+    conditions: List[JobCondition] = field(default_factory=list)
+    replica_statuses: Dict[str, ReplicaStatus] = field(default_factory=dict)
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+    last_reconcile_time: Optional[float] = None
+
+
+@dataclass
+class SchedulingPolicy:
+    """Gang-scheduling knobs (ref: vendor/.../apis/common/v1/types.go:148-154).
+
+    min_available defaults to the total replica count — on TPUs a training
+    gang below full slice membership cannot make progress.
+    """
+
+    min_available: Optional[int] = None
+    queue: str = ""
+
+
+@dataclass
+class RunPolicy:
+    """Job-level lifecycle policy (ref: vendor/.../apis/common/v1/types.go:156-201)."""
+
+    clean_pod_policy: Optional[CleanPodPolicy] = None
+    ttl_seconds_after_finished: Optional[int] = None
+    active_deadline_seconds: Optional[float] = None
+    backoff_limit: Optional[int] = None
+    scheduling_policy: Optional[SchedulingPolicy] = None
+
+
+@dataclass
+class TPUTopology:
+    """TPU-native addition: what fabric this replica group runs on.
+
+    The reference expresses accelerators only as an opaque resource request in
+    the pod template (nvidia.com/gpu); TPU slices need structure — the slice
+    shape determines gang size, and the logical mesh determines how the
+    training runtime lays out dp/tp/sp axes over ICI.
+    """
+
+    accelerator: str = ""  # e.g. "v5litepod-8"
+    topology: str = ""  # physical chip topology, e.g. "2x4"
+    # Logical mesh requested for the workload, axis name -> size,
+    # e.g. {"dp": 2, "tp": 4}.  Injected as TPUJOB_MESH_SHAPE.
+    mesh: Dict[str, int] = field(default_factory=dict)
+
+    def num_chips(self) -> int:
+        if not self.topology:
+            return 0
+        n = 1
+        for part in self.topology.lower().split("x"):
+            n *= int(part)
+        return n
+
+
+@dataclass
+class ReplicaSpec:
+    """(ref: vendor/.../apis/common/v1/types.go:79-92)"""
+
+    replicas: Optional[int] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    restart_policy: Optional[RestartPolicy] = None
+    tpu: Optional[TPUTopology] = None
+
+
+@dataclass
+class TPUJobSpec:
+    """(ref: pkg/apis/tensorflow/v1/types.go:47-68)"""
+
+    replica_specs: Dict[ReplicaType, ReplicaSpec] = field(default_factory=dict)
+    run_policy: RunPolicy = field(default_factory=RunPolicy)
+    success_policy: Optional[SuccessPolicy] = None
+    # Each worker sees a sparse cluster spec (itself + all PS) and workers may
+    # be scaled without restarting the job (ref: types.go:61-67).
+    enable_dynamic_worker: bool = False
+
+
+@dataclass
+class TPUJob:
+    """The TPUJob resource (ref: pkg/apis/tensorflow/v1/types.go:27-44)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: JobStatus = field(default_factory=JobStatus)
+
+    kind: str = "TPUJob"
+
+    def deepcopy(self) -> "TPUJob":
+        return copy.deepcopy(self)
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+# --- type helpers (ref: pkg/apis/tensorflow/v1/util.go:22-34) ---
+
+def is_chief_or_master(rtype: ReplicaType) -> bool:
+    return rtype in (ReplicaType.CHIEF, ReplicaType.MASTER)
+
+
+def is_worker(rtype: ReplicaType) -> bool:
+    return rtype == ReplicaType.WORKER
+
+
+def is_evaluator(rtype: ReplicaType) -> bool:
+    return rtype == ReplicaType.EVALUATOR
+
+
+def contains_chief_or_master(job: TPUJob) -> bool:
+    """(ref: pkg/controller.v1/tensorflow/util.go:45-52)"""
+    return any(is_chief_or_master(rt) for rt in job.spec.replica_specs)

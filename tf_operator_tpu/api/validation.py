@@ -1,0 +1,105 @@
+"""TPUJob spec validation.
+
+Behavioral contract of the reference's ValidateV1TFJobSpec
+(/root/reference/pkg/apis/tensorflow/validation/validation.go:27-73):
+  - replica specs must be non-empty and each non-nil
+  - each template must have ≥1 container
+  - images must be non-empty
+  - exactly one container per template must carry the operator container name
+  - at most one Chief/Master replica
+  - at most one Evaluator replica
+
+TPU additions: topology strings must parse ("AxB[xC]"), logical mesh size (if
+given) must equal the slice chip count, and unknown replica-type keys are
+rejected (the reference rejects these implicitly through its typed API).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from . import constants
+from .defaults import normalize_replica_type
+from .types import ReplicaType, TPUJob, TPUJobSpec
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def validate(job: TPUJob) -> None:
+    if not job.metadata.name:
+        raise ValidationError("TPUJob must have a name")
+    validate_spec(job.spec)
+
+
+def validate_spec(spec: TPUJobSpec) -> None:
+    if not spec.replica_specs:
+        raise ValidationError("TPUJobSpec is not valid: replica_specs is empty")
+
+    for key, rspec in spec.replica_specs.items():
+        rtype = normalize_replica_type(key)
+        if rtype is None:
+            valid = ", ".join(rt.value for rt in ReplicaType)
+            raise ValidationError(
+                f"TPUJobSpec is not valid: unknown replica type {key!r} (valid: {valid})"
+            )
+        if rspec is None:
+            raise ValidationError(f"TPUJobSpec is not valid: replica spec for {rtype.value} is nil")
+        _validate_replica(rtype, rspec)
+
+    _validate_singleton(spec, (ReplicaType.CHIEF, ReplicaType.MASTER), "chief/master")
+    _validate_singleton(spec, (ReplicaType.EVALUATOR,), "evaluator")
+
+
+def _validate_singleton(spec: TPUJobSpec, rtypes, label: str) -> None:
+    """≤1 replica across the given types (ref: validation.go:58-71)."""
+    count = 0
+    for key, rspec in spec.replica_specs.items():
+        if normalize_replica_type(key) in rtypes and rspec is not None:
+            count += int(rspec.replicas or 1)
+    if count > 1:
+        raise ValidationError(f"TPUJobSpec is not valid: more than one {label} replica specified")
+
+
+def _validate_replica(rtype: ReplicaType, rspec) -> None:
+    containers = rspec.template.containers
+    if not containers:
+        raise ValidationError(
+            f"TPUJobSpec is not valid: containers for {rtype.value} replica is empty"
+        )
+
+    named: List[str] = []
+    for c in containers:
+        if not c.image:
+            raise ValidationError(
+                f"TPUJobSpec is not valid: image for {rtype.value} container {c.name!r} is empty"
+            )
+        if c.name in (constants.DEFAULT_CONTAINER_NAME, constants.ALT_CONTAINER_NAME):
+            named.append(c.name)
+    if len(named) == 0:
+        raise ValidationError(
+            "TPUJobSpec is not valid: there is no container named "
+            f"{constants.DEFAULT_CONTAINER_NAME!r} or {constants.ALT_CONTAINER_NAME!r} "
+            f"in the {rtype.value} replica template"
+        )
+    if len(named) > 1:
+        raise ValidationError(
+            f"TPUJobSpec is not valid: more than one operator container in {rtype.value} template"
+        )
+
+    if rspec.tpu is not None and rspec.tpu.topology:
+        try:
+            chips = rspec.tpu.num_chips()
+        except ValueError:
+            raise ValidationError(
+                f"TPUJobSpec is not valid: malformed TPU topology {rspec.tpu.topology!r}"
+            ) from None
+        if rspec.tpu.mesh:
+            mesh_size = 1
+            for size in rspec.tpu.mesh.values():
+                mesh_size *= size
+            if mesh_size != chips:
+                raise ValidationError(
+                    f"TPUJobSpec is not valid: logical mesh {rspec.tpu.mesh} has "
+                    f"{mesh_size} devices but topology {rspec.tpu.topology!r} has {chips} chips"
+                )

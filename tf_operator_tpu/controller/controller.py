@@ -1,0 +1,276 @@
+"""TPUJobController: the job-type-specific brain.
+
+Re-architecture of the reference's TFController
+(/root/reference/pkg/controller.v1/tensorflow/controller.go,job.go,pod.go):
+watch handlers feed a rate-limited workqueue; N worker threads pop keys and
+run the generic reconcile engine with TPU-specific plugin hooks (topology
+injection, master-role labeling, success matrix).  Expectations gate syncs so
+a stale store view never causes duplicate pod creation
+(ref: controller.go:319,339-358).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..api import constants
+from ..api.core import Event, Pod, Service
+from ..api.defaults import set_defaults
+from ..api.types import (
+    JobConditionType,
+    ReplicaSpec,
+    ReplicaType,
+    TPUJob,
+    contains_chief_or_master,
+)
+from ..api.validation import ValidationError, validate
+from ..runtime import conditions
+from ..runtime.cluster import ClusterInterface, EventType, NotFound
+from ..runtime.control import RealPodControl, RealServiceControl
+from ..runtime.expectations import expectation_key
+from ..runtime.reconciler import (
+    JobPlugin,
+    JobReconciler,
+    ReconcilerConfig,
+)
+from ..runtime.workqueue import RateLimitingQueue, ShutDown
+from ..utils import logging as tpulog
+from ..utils import metrics
+from . import status as status_engine
+from . import topology
+
+CONTROLLER_NAME = "tpujob-controller"
+
+FAILED_VALIDATION_REASON = "FailedValidation"
+
+
+class TPUJobController(JobPlugin):
+    def __init__(
+        self,
+        cluster: ClusterInterface,
+        config: Optional[ReconcilerConfig] = None,
+        resolver: topology.AddressResolver = topology.dns_resolver,
+        threadiness: int = 1,
+    ) -> None:
+        self.controller_name = CONTROLLER_NAME
+        self.cluster = cluster
+        self.resolver = resolver
+        self.threadiness = threadiness
+        self.work_queue = RateLimitingQueue()
+        self.pod_control = RealPodControl(cluster)
+        self.service_control = RealServiceControl(cluster)
+        self.reconciler = JobReconciler(
+            cluster=cluster,
+            pod_control=self.pod_control,
+            service_control=self.service_control,
+            plugin=self,
+            config=config,
+        )
+        self.expectations = self.reconciler.expectations
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._sync_errors: Dict[str, str] = {}
+
+        cluster.watch_jobs(self._on_job_event)
+        cluster.watch_pods(self._on_pod_event)
+        cluster.watch_services(self._on_service_event)
+
+    # ------------------------------------------------------------------
+    # watch handlers (ref: controller.go:135-175; job.go:54-170;
+    # common/pod.go:73-214)
+
+    def _on_job_event(self, etype: EventType, job: TPUJob) -> None:
+        if etype == EventType.ADDED:
+            self.add_job(job)
+        elif etype == EventType.MODIFIED:
+            self.work_queue.add(job.key())
+        elif etype == EventType.DELETED:
+            # Pods/services are garbage-collected by ownership in real k8s;
+            # our substrates clean up on terminal state instead.
+            self.expectations.delete_expectations(job.key())
+
+    def add_job(self, job: TPUJob) -> None:
+        """Admission: validate, default, stamp JobCreated, enqueue
+        (ref: addTFJob, job.go:54-131)."""
+        try:
+            validate(job)
+        except ValidationError as err:
+            # Reject: write a Failed condition + warning event, do not enqueue
+            # (ref: job.go:65-105).
+            conditions.update_job_conditions(
+                job.status, JobConditionType.FAILED, FAILED_VALIDATION_REASON, str(err)
+            )
+            self.cluster.record_event(
+                Event(
+                    object_kind=job.kind,
+                    object_name=job.metadata.name,
+                    namespace=job.metadata.namespace,
+                    event_type="Warning",
+                    reason=FAILED_VALIDATION_REASON,
+                    message=str(err),
+                )
+            )
+            try:
+                self.cluster.update_job_status(
+                    job.metadata.namespace, job.metadata.name, job.status
+                )
+            except NotFound:
+                pass
+            return
+
+        set_defaults(job)
+        conditions.update_job_conditions(
+            job.status,
+            JobConditionType.CREATED,
+            "TPUJobCreated",
+            f"TPUJob {job.metadata.name} is created.",
+        )
+        metrics.jobs_created.labels().inc()
+        self.work_queue.add(job.key())
+
+    def _on_pod_event(self, etype: EventType, pod: Pod) -> None:
+        key = self._owner_key(pod)
+        if key is None:
+            return
+        rtype = pod.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
+        if etype == EventType.ADDED:
+            self.expectations.creation_observed(expectation_key(key, rtype, "pods"))
+        elif etype == EventType.DELETED:
+            self.expectations.deletion_observed(expectation_key(key, rtype, "pods"))
+        self.work_queue.add(key)
+
+    def _on_service_event(self, etype: EventType, svc: Service) -> None:
+        key = self._owner_key(svc)
+        if key is None:
+            return
+        rtype = svc.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
+        if etype == EventType.ADDED:
+            self.expectations.creation_observed(expectation_key(key, rtype, "services"))
+        elif etype == EventType.DELETED:
+            self.expectations.deletion_observed(expectation_key(key, rtype, "services"))
+        self.work_queue.add(key)
+
+    @staticmethod
+    def _owner_key(obj) -> Optional[str]:
+        meta = obj.metadata
+        if meta.owner_kind != "TPUJob" or not meta.owner_name:
+            return None
+        return f"{meta.namespace}/{meta.owner_name}"
+
+    # ------------------------------------------------------------------
+    # sync loop (ref: Run/runWorker/processNextWorkItem, controller.go:186-274)
+
+    def run(self, stop_after: Optional[float] = None) -> None:
+        """Start worker threads; blocks until stop() (or stop_after seconds)."""
+        self.start()
+        if stop_after is not None:
+            time.sleep(stop_after)
+            self.stop()
+        else:
+            while not self._stop.is_set():
+                time.sleep(0.2)
+
+    def start(self) -> None:
+        """Non-blocking run()."""
+        for i in range(self.threadiness):
+            t = threading.Thread(target=self._run_worker, name=f"tpujob-worker-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        resync = threading.Thread(target=self._resync_loop, name="tpujob-resync", daemon=True)
+        resync.start()
+        self._threads.append(resync)
+
+    def _resync_loop(self) -> None:
+        """Periodic full resync (ref: ReconcilerSyncLoopPeriod 15s,
+        common/job_controller.go:60-77): the backstop for timer-driven
+        policies (TTL, ActiveDeadlineSeconds) across controller restarts."""
+        period = self.reconciler.config.reconciler_sync_loop_period
+        while not self._stop.wait(timeout=period):
+            for job in self.cluster.list_jobs():
+                self.work_queue.add(job.key())
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.work_queue.shutdown()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _run_worker(self) -> None:
+        while not self._stop.is_set():
+            try:
+                key = self.work_queue.get(timeout=0.5)
+            except ShutDown:
+                return
+            except TimeoutError:
+                continue
+            try:
+                self.sync_job(key)
+                self.work_queue.forget(key)
+            except Exception as err:  # noqa: BLE001 — sync errors requeue with backoff
+                self._sync_errors[key] = str(err)
+                tpulog.logger_for_key(key).warning("sync failed: %s", err)
+                self.work_queue.add_rate_limited(key)
+            finally:
+                self.work_queue.done(key)
+
+    def sync_job(self, key: str) -> bool:
+        """One reconcile pass for `key` (ref: syncTFJob, controller.go:290-334).
+        Returns True if a reconcile ran (expectations satisfied)."""
+        namespace, _, name = key.partition("/")
+        try:
+            job = self.cluster.get_job(namespace, name)
+        except NotFound:
+            self.expectations.delete_expectations(key)
+            return True
+
+        job = job.deepcopy()
+        set_defaults(job)
+
+        # Sync gate: only act on a caught-up view — unless dynamic workers
+        # force every-loop syncs (ref: controller.go:319).
+        if not (self.satisfied_expectations(job) or job.spec.enable_dynamic_worker):
+            return False
+
+        result = self.reconciler.reconcile_job(job)
+        if result.requeue_after is not None:
+            self.work_queue.add_after(key, result.requeue_after)
+        return True
+
+    def satisfied_expectations(self, job: TPUJob) -> bool:
+        """(ref: satisfiedExpectations, controller.go:339-358)"""
+        key = job.key()
+        return all(
+            self.expectations.satisfied(expectation_key(key, rtype.value, kind))
+            for rtype in job.spec.replica_specs
+            for kind in ("pods", "services")
+        )
+
+    # ------------------------------------------------------------------
+    # JobPlugin hooks
+
+    def set_cluster_spec(self, job: TPUJob, pod: Pod, rtype: ReplicaType, index: int) -> None:
+        topology.set_cluster_spec(job, pod, rtype, index, self.resolver)
+
+    def is_master_role(
+        self, replicas: Dict[ReplicaType, ReplicaSpec], rtype: ReplicaType, index: int
+    ) -> bool:
+        """Chief/Master pod if declared, else worker-0
+        (ref: controller.go:409-416)."""
+        if any(rt in (ReplicaType.CHIEF, ReplicaType.MASTER) for rt in replicas):
+            return rtype in (ReplicaType.CHIEF, ReplicaType.MASTER)
+        return rtype == ReplicaType.WORKER and index == 0
+
+    def update_job_status(self, job: TPUJob, replicas, status, pods, restarting_this_pass) -> None:
+        status_engine.update_job_status(
+            job,
+            replicas,
+            status,
+            pods,
+            restarting_this_pass=restarting_this_pass,
+            record_event=self.cluster.record_event,
+            on_start_time_set=lambda deadline: self.work_queue.add_after(job.key(), deadline),
+        )
+
+    def on_pod_created(self, job: TPUJob, rtype: ReplicaType) -> None:
+        pass

@@ -1,0 +1,181 @@
+"""Cluster-topology generation: TF_CONFIG plus the TPU/JAX coordination env.
+
+This is the single injection point the reference calls SetClusterSpec
+(/root/reference/pkg/controller.v1/tensorflow/pod.go:250-283 and
+tensorflow.go:97-173), re-imagined for TPUs:
+
+  - TF_CONFIG is emitted byte-compatible with the reference (dense
+    {"cluster","task","environment":"cloud"}; sparse {"sparseCluster","task"}
+    for EnableDynamicWorker) so reference TFJobs run unmodified.
+  - Additionally a TPU-native topology document is emitted as env vars:
+    coordinator address + process id/count (`jax.distributed.initialize`
+    inputs), slice topology and logical mesh shape (so the training runtime
+    can lay dp/tp/sp axes over ICI without re-discovering the fabric).
+
+Addresses default to headless-service DNS names
+`<job>-<rtype>-<idx>.<ns>.svc[.<CUSTOM_CLUSTER_DOMAIN>]:<port>`
+(ref: tensorflow.go:153-166); local runtimes may override via resolver.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, Optional
+
+from ..api import constants
+from ..api.core import Pod
+from ..api.types import (
+    REPLICA_TYPE_ORDER,
+    ReplicaType,
+    TPUJob,
+    is_chief_or_master,
+)
+from ..runtime.reconciler import gen_general_name, get_port_from_job
+
+# resolver(job, rtype, index, port) -> "host:port"
+AddressResolver = Callable[[TPUJob, ReplicaType, int, int], str]
+
+
+def dns_resolver(job: TPUJob, rtype: ReplicaType, index: int, port: int) -> str:
+    """(ref: tensorflow.go:153-166)"""
+    host = gen_general_name(job.metadata.name, rtype.value, index)
+    svc = f"{host}.{job.metadata.namespace}.svc"
+    domain = os.environ.get(constants.ENV_CUSTOM_CLUSTER_DOMAIN, "")
+    if domain:
+        svc += f".{domain}"
+    return f"{svc}:{port}"
+
+
+def gen_cluster_spec(
+    job: TPUJob, resolver: AddressResolver = dns_resolver
+) -> Dict[str, List[str]]:
+    """{replica-type-lowercase: [host:port, ...]} (ref: genClusterSpec,
+    tensorflow.go:142-173)."""
+    cluster: Dict[str, List[str]] = {}
+    for rtype, rspec in job.spec.replica_specs.items():
+        port = get_port_from_job(job.spec, rtype)
+        cluster[rtype.value.lower()] = [
+            resolver(job, rtype, i, port) for i in range(int(rspec.replicas or 0))
+        ]
+    return cluster
+
+
+def sparse_cluster_spec(
+    cluster: Dict[str, List[str]], rtype: str, index: int
+) -> Dict[str, object]:
+    """Each worker sees itself + all PS; each PS sees only itself
+    (ref: convertClusterSpecToSparseClusterSpec, tensorflow.go:74-84)."""
+    sparse: Dict[str, object] = {"worker": {}, "ps": []}
+    if rtype == "ps":
+        sparse["ps"] = [cluster[rtype][index]]
+    elif rtype == "worker":
+        sparse["ps"] = list(cluster.get("ps", []))
+        sparse["worker"] = {index: cluster[rtype][index]}
+    return sparse
+
+
+def gen_tf_config(
+    job: TPUJob, rtype: ReplicaType, index: int, resolver: AddressResolver = dns_resolver
+) -> str:
+    """The TF_CONFIG JSON string (ref: genTFConfigJSONStr, tensorflow.go:97-139)."""
+    cluster = gen_cluster_spec(job, resolver)
+    rt = rtype.value.lower()
+    if job.spec.enable_dynamic_worker:
+        payload: Dict[str, object] = {
+            "sparseCluster": sparse_cluster_spec(cluster, rt, index),
+            "task": {"type": rt, "index": index},
+        }
+    else:
+        payload = {
+            "cluster": cluster,
+            "task": {"type": rt, "index": index},
+            "environment": "cloud",
+        }
+    return json.dumps(payload, separators=(",", ":"))
+
+
+def is_distributed(job: TPUJob) -> bool:
+    """Single-process jobs get no TF_CONFIG (ref: isDistributed, pod.go:287-308)."""
+    count = 0
+    for rtype in REPLICA_TYPE_ORDER:
+        rspec = job.spec.replica_specs.get(rtype)
+        if rspec is not None:
+            count += int(rspec.replicas) if rspec.replicas is not None else 1
+    return count != 1
+
+
+# ---------------------------------------------------------------------------
+# TPU-native topology document
+
+# Replica types that host accelerator processes and therefore get JAX
+# coordination env.  PS/Evaluator are CPU-side and excluded from the
+# jax.distributed process group.
+_JAX_PROCESS_TYPES = (ReplicaType.CHIEF, ReplicaType.MASTER, ReplicaType.WORKER)
+
+
+def jax_process_layout(job: TPUJob) -> List[tuple]:
+    """Deterministic (rtype, index) -> process-id order: chief/master first
+    (they coordinate), then workers — the TPU analogue of the reference's
+    'chief else worker-0 is master' rule (controller.go:409-416)."""
+    layout = []
+    for rtype in (ReplicaType.CHIEF, ReplicaType.MASTER, ReplicaType.WORKER):
+        rspec = job.spec.replica_specs.get(rtype)
+        if rspec is not None:
+            for i in range(int(rspec.replicas or 0)):
+                layout.append((rtype, i))
+    return layout
+
+
+def gen_tpu_env(
+    job: TPUJob, rtype: ReplicaType, index: int, resolver: AddressResolver = dns_resolver
+) -> Dict[str, str]:
+    """The TPU-native topology document, one env-var map per process."""
+    env: Dict[str, str] = {
+        constants.ENV_REPLICA_TYPE: rtype.value.lower(),
+        constants.ENV_REPLICA_INDEX: str(index),
+    }
+    layout = jax_process_layout(job)
+    if layout:
+        coord_rtype, coord_index = layout[0]
+        coord_port = get_port_from_job(job.spec, coord_rtype)
+        env[constants.ENV_COORDINATOR_ADDRESS] = resolver(
+            job, coord_rtype, coord_index, coord_port
+        )
+        env[constants.ENV_NUM_PROCESSES] = str(len(layout))
+        if rtype in _JAX_PROCESS_TYPES:
+            try:
+                env[constants.ENV_PROCESS_ID] = str(layout.index((rtype, index)))
+            except ValueError:
+                pass
+
+    rspec = job.spec.replica_specs.get(rtype)
+    if rspec is not None and rspec.tpu is not None:
+        if rspec.tpu.accelerator:
+            env[constants.ENV_ACCELERATOR] = rspec.tpu.accelerator
+        if rspec.tpu.topology:
+            env[constants.ENV_SLICE_TOPOLOGY] = rspec.tpu.topology
+        if rspec.tpu.mesh:
+            env[constants.ENV_MESH_SHAPE] = json.dumps(
+                rspec.tpu.mesh, separators=(",", ":")
+            )
+    return env
+
+
+def set_cluster_spec(
+    job: TPUJob,
+    pod: Pod,
+    rtype: ReplicaType,
+    index: int,
+    resolver: AddressResolver = dns_resolver,
+) -> None:
+    """Inject TF_CONFIG + TPU env into the operator container of `pod`
+    (ref: SetClusterSpec, pod.go:250-283 — skipped when non-distributed)."""
+    container = pod.spec.container(
+        constants.DEFAULT_CONTAINER_NAME, constants.ALT_CONTAINER_NAME
+    )
+    if container is None:
+        return
+    if is_distributed(job):
+        container.set_env(constants.ENV_TF_CONFIG, gen_tf_config(job, rtype, index, resolver))
+    for name, value in gen_tpu_env(job, rtype, index, resolver).items():
+        container.set_env(name, value)

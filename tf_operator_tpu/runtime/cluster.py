@@ -1,0 +1,339 @@
+"""ClusterInterface: the seam between the reconcile engine and the substrate.
+
+The reference talks to a Kubernetes apiserver through client-go informers and
+clientsets; its unit tests replace those with fake clients + indexer injection
+(/root/reference/pkg/controller.v1/tensorflow/controller_test.go:45-66,
+pkg/common/util/v1/testutil/).  This framework makes that seam explicit: the
+controller only ever sees `ClusterInterface`, and backends provide it:
+
+  - InMemoryCluster   — a synchronous in-process object store with watch
+                        callbacks.  It is both the unit-test fake (tests mutate
+                        pod phases directly, the analogue of SetPodsStatuses,
+                        testutil/pod.go:67-95) and the base for the local
+                        process runtime.
+  - LocalProcessCluster (runtime/local.py) — pods become real subprocesses;
+                        hermetic E2E and real single-host TPU runs.
+  - a real Kubernetes backend can implement the same interface with client-go
+    semantics (out of scope for a TPU-sandbox build, API shape kept compatible).
+
+Watch events fire synchronously after the store mutation commits, mirroring
+informer delivery order for a single writer.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api.core import Event, ObjectMeta, Pod, PodGroup, Service
+from ..api.types import JobStatus, TPUJob
+
+
+class EventType(str, Enum):
+    ADDED = "ADDED"
+    MODIFIED = "MODIFIED"
+    DELETED = "DELETED"
+
+
+WatchHandler = Callable[[EventType, object], None]
+
+
+class NotFound(KeyError):
+    pass
+
+
+class AlreadyExists(ValueError):
+    pass
+
+
+class ClusterInterface:
+    """Abstract substrate API (create/get/list/update/delete + watch)."""
+
+    # jobs
+    def create_job(self, job: TPUJob) -> TPUJob: ...
+    def get_job(self, namespace: str, name: str) -> TPUJob: ...
+    def list_jobs(self, namespace: Optional[str] = None) -> List[TPUJob]: ...
+    def update_job(self, job: TPUJob) -> TPUJob: ...
+    def update_job_status(self, namespace: str, name: str, status: JobStatus) -> TPUJob: ...
+    def delete_job(self, namespace: str, name: str) -> None: ...
+
+    # pods
+    def create_pod(self, pod: Pod) -> Pod: ...
+    def get_pod(self, namespace: str, name: str) -> Pod: ...
+    def list_pods(self, namespace: Optional[str] = None, selector: Optional[Dict[str, str]] = None) -> List[Pod]: ...
+    def update_pod(self, pod: Pod) -> Pod: ...
+    def delete_pod(self, namespace: str, name: str) -> None: ...
+
+    # services
+    def create_service(self, svc: Service) -> Service: ...
+    def list_services(self, namespace: Optional[str] = None, selector: Optional[Dict[str, str]] = None) -> List[Service]: ...
+    def delete_service(self, namespace: str, name: str) -> None: ...
+
+    # pod groups (gang scheduling)
+    def create_podgroup(self, pg: PodGroup) -> PodGroup: ...
+    def get_podgroup(self, namespace: str, name: str) -> PodGroup: ...
+    def delete_podgroup(self, namespace: str, name: str) -> None: ...
+
+    # events
+    def record_event(self, event: Event) -> None: ...
+    def list_events(self, namespace: Optional[str] = None, object_name: Optional[str] = None) -> List[Event]: ...
+
+    # watches
+    def watch_jobs(self, handler: WatchHandler) -> None: ...
+    def watch_pods(self, handler: WatchHandler) -> None: ...
+    def watch_services(self, handler: WatchHandler) -> None: ...
+
+    # leases (leader election)
+    def try_acquire_lease(self, name: str, holder: str, ttl: float) -> bool: ...
+
+
+def _matches(labels: Dict[str, str], selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class InMemoryCluster(ClusterInterface):
+    """Thread-safe in-memory substrate with synchronous watch delivery."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._jobs: Dict[Tuple[str, str], TPUJob] = {}
+        self._pods: Dict[Tuple[str, str], Pod] = {}
+        self._services: Dict[Tuple[str, str], Service] = {}
+        self._podgroups: Dict[Tuple[str, str], PodGroup] = {}
+        self._events: List[Event] = []
+        self._leases: Dict[str, Tuple[str, float]] = {}  # name -> (holder, expiry)
+        self._job_handlers: List[WatchHandler] = []
+        self._pod_handlers: List[WatchHandler] = []
+        self._svc_handlers: List[WatchHandler] = []
+        self._uid_counter = itertools.count(1)
+
+    def _assign_uid(self, meta: ObjectMeta, kind: str) -> None:
+        if not meta.uid:
+            meta.uid = f"{kind}-{next(self._uid_counter)}"
+
+    def _dispatch(self, handlers: List[WatchHandler], etype: EventType, obj) -> None:
+        for h in list(handlers):
+            h(etype, obj)
+
+    # --- jobs ---
+
+    def create_job(self, job: TPUJob) -> TPUJob:
+        key = (job.metadata.namespace, job.metadata.name)
+        with self._lock:
+            if key in self._jobs:
+                raise AlreadyExists(f"tpujob {key} already exists")
+            self._assign_uid(job.metadata, "tpujob")
+            self._jobs[key] = job
+        self._dispatch(self._job_handlers, EventType.ADDED, job)
+        return job
+
+    def get_job(self, namespace: str, name: str) -> TPUJob:
+        with self._lock:
+            try:
+                return self._jobs[(namespace, name)]
+            except KeyError:
+                raise NotFound(f"tpujob {namespace}/{name} not found") from None
+
+    def list_jobs(self, namespace: Optional[str] = None) -> List[TPUJob]:
+        with self._lock:
+            return [
+                j for (ns, _), j in self._jobs.items() if namespace in (None, ns)
+            ]
+
+    def update_job(self, job: TPUJob) -> TPUJob:
+        key = (job.metadata.namespace, job.metadata.name)
+        with self._lock:
+            if key not in self._jobs:
+                raise NotFound(f"tpujob {key} not found")
+            self._jobs[key] = job
+        self._dispatch(self._job_handlers, EventType.MODIFIED, job)
+        return job
+
+    def update_job_status(self, namespace: str, name: str, status: JobStatus) -> TPUJob:
+        """Status-subresource write (ref: status.go:207-225)."""
+        with self._lock:
+            job = self.get_job(namespace, name)
+            job.status = status
+        self._dispatch(self._job_handlers, EventType.MODIFIED, job)
+        return job
+
+    def delete_job(self, namespace: str, name: str) -> None:
+        with self._lock:
+            job = self._jobs.pop((namespace, name), None)
+        if job is None:
+            raise NotFound(f"tpujob {namespace}/{name} not found")
+        self._dispatch(self._job_handlers, EventType.DELETED, job)
+
+    # --- pods ---
+
+    def create_pod(self, pod: Pod) -> Pod:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        with self._lock:
+            if key in self._pods:
+                raise AlreadyExists(f"pod {key} already exists")
+            self._assign_uid(pod.metadata, "pod")
+            self._pods[key] = pod
+        self._started_pod(pod)
+        self._dispatch(self._pod_handlers, EventType.ADDED, pod)
+        return pod
+
+    def _started_pod(self, pod: Pod) -> None:
+        """Hook for subclasses that actually run pods (LocalProcessCluster)."""
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        with self._lock:
+            try:
+                return self._pods[(namespace, name)]
+            except KeyError:
+                raise NotFound(f"pod {namespace}/{name} not found") from None
+
+    def list_pods(self, namespace=None, selector=None) -> List[Pod]:
+        with self._lock:
+            return [
+                p
+                for (ns, _), p in self._pods.items()
+                if namespace in (None, ns) and _matches(p.metadata.labels, selector)
+            ]
+
+    def update_pod(self, pod: Pod) -> Pod:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        with self._lock:
+            if key not in self._pods:
+                raise NotFound(f"pod {key} not found")
+            self._pods[key] = pod
+        self._dispatch(self._pod_handlers, EventType.MODIFIED, pod)
+        return pod
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        with self._lock:
+            pod = self._pods.pop((namespace, name), None)
+        if pod is None:
+            raise NotFound(f"pod {namespace}/{name} not found")
+        self._stopped_pod(pod)
+        self._dispatch(self._pod_handlers, EventType.DELETED, pod)
+
+    def _stopped_pod(self, pod: Pod) -> None:
+        """Hook for subclasses that actually run pods."""
+
+    # --- services ---
+
+    def create_service(self, svc: Service) -> Service:
+        key = (svc.metadata.namespace, svc.metadata.name)
+        with self._lock:
+            if key in self._services:
+                raise AlreadyExists(f"service {key} already exists")
+            self._assign_uid(svc.metadata, "svc")
+            self._services[key] = svc
+        self._dispatch(self._svc_handlers, EventType.ADDED, svc)
+        return svc
+
+    def list_services(self, namespace=None, selector=None) -> List[Service]:
+        with self._lock:
+            return [
+                s
+                for (ns, _), s in self._services.items()
+                if namespace in (None, ns) and _matches(s.metadata.labels, selector)
+            ]
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        with self._lock:
+            svc = self._services.pop((namespace, name), None)
+        if svc is None:
+            raise NotFound(f"service {namespace}/{name} not found")
+        self._dispatch(self._svc_handlers, EventType.DELETED, svc)
+
+    # --- pod groups ---
+
+    def create_podgroup(self, pg: PodGroup) -> PodGroup:
+        key = (pg.metadata.namespace, pg.metadata.name)
+        with self._lock:
+            if key in self._podgroups:
+                raise AlreadyExists(f"podgroup {key} already exists")
+            self._assign_uid(pg.metadata, "pg")
+            self._podgroups[key] = pg
+        return pg
+
+    def get_podgroup(self, namespace: str, name: str) -> PodGroup:
+        with self._lock:
+            try:
+                return self._podgroups[(namespace, name)]
+            except KeyError:
+                raise NotFound(f"podgroup {namespace}/{name} not found") from None
+
+    def delete_podgroup(self, namespace: str, name: str) -> None:
+        with self._lock:
+            if self._podgroups.pop((namespace, name), None) is None:
+                raise NotFound(f"podgroup {namespace}/{name} not found")
+
+    # --- events ---
+
+    def record_event(self, event: Event) -> None:
+        with self._lock:
+            self._events.append(event)
+
+    def list_events(self, namespace=None, object_name=None) -> List[Event]:
+        with self._lock:
+            return [
+                e
+                for e in self._events
+                if namespace in (None, e.namespace)
+                and object_name in (None, e.object_name)
+            ]
+
+    # --- watches ---
+
+    def watch_jobs(self, handler: WatchHandler) -> None:
+        self._job_handlers.append(handler)
+
+    def watch_pods(self, handler: WatchHandler) -> None:
+        self._pod_handlers.append(handler)
+
+    def watch_services(self, handler: WatchHandler) -> None:
+        self._svc_handlers.append(handler)
+
+    # --- leases ---
+
+    def try_acquire_lease(self, name: str, holder: str, ttl: float) -> bool:
+        """EndpointsLock analogue (ref: cmd/tf-operator.v1/app/server.go:159-184)."""
+        now = time.time()
+        with self._lock:
+            current = self._leases.get(name)
+            if current is None or current[1] < now or current[0] == holder:
+                self._leases[name] = (holder, now + ttl)
+                return True
+            return False
+
+    def lease_holder(self, name: str) -> Optional[str]:
+        with self._lock:
+            current = self._leases.get(name)
+            if current is None or current[1] < time.time():
+                return None
+            return current[0]
+
+    # --- test helpers (the SetPodsStatuses analogue, testutil/pod.go:67-95) ---
+
+    def set_pod_phase(self, namespace: str, name: str, phase, exit_code=None,
+                      restart_count: Optional[int] = None) -> Pod:
+        from ..api.core import ContainerStatus, PodPhase
+
+        with self._lock:
+            pod = self.get_pod(namespace, name)
+            pod.status.phase = phase
+            if pod.status.start_time is None and phase != PodPhase.PENDING:
+                pod.status.start_time = time.time()
+            if not pod.status.container_statuses:
+                cname = pod.spec.containers[0].name if pod.spec.containers else "tensorflow"
+                pod.status.container_statuses = [ContainerStatus(name=cname)]
+            cs = pod.status.container_statuses[0]
+            cs.running = phase == PodPhase.RUNNING
+            if exit_code is not None:
+                cs.terminated = True
+                cs.exit_code = exit_code
+            if restart_count is not None:
+                cs.restart_count = restart_count
+        self._dispatch(self._pod_handlers, EventType.MODIFIED, pod)
+        return pod

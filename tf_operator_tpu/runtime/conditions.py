@@ -1,0 +1,104 @@
+"""Job condition bookkeeping.
+
+Behavioral contract of the reference's status helpers
+(/root/reference/vendor/github.com/kubeflow/common/pkg/util/status.go:35-122):
+  - appending a condition replaces any existing one of the same type,
+    preserving last_transition_time when (status, reason) are unchanged
+  - Running and Restarting are mutually exclusive: setting one removes the other
+  - a terminal condition (Succeeded/Failed) flips Running to False rather than
+    removing it
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ..api.types import JobCondition, JobConditionType, JobStatus
+
+
+def new_condition(
+    ctype: JobConditionType, reason: str, message: str, status: bool = True
+) -> JobCondition:
+    now = time.time()
+    return JobCondition(
+        type=ctype,
+        status=status,
+        reason=reason,
+        message=message,
+        last_update_time=now,
+        last_transition_time=now,
+    )
+
+
+def get_condition(status: JobStatus, ctype: JobConditionType) -> Optional[JobCondition]:
+    for c in status.conditions:
+        if c.type == ctype:
+            return c
+    return None
+
+
+def has_condition(status: JobStatus, ctype: JobConditionType) -> bool:
+    c = get_condition(status, ctype)
+    return c is not None and c.status
+
+
+def is_succeeded(status: JobStatus) -> bool:
+    return has_condition(status, JobConditionType.SUCCEEDED)
+
+
+def is_failed(status: JobStatus) -> bool:
+    return has_condition(status, JobConditionType.FAILED)
+
+
+def is_finished(status: JobStatus) -> bool:
+    return is_succeeded(status) or is_failed(status)
+
+
+def is_running(status: JobStatus) -> bool:
+    return has_condition(status, JobConditionType.RUNNING)
+
+
+def update_job_conditions(
+    status: JobStatus, ctype: JobConditionType, reason: str, message: str
+) -> None:
+    """Set condition `ctype` true, with the reference's exclusion rules
+    (ref: util/status.go:55-122):
+      - a Failed job is sticky: no further condition changes (status.go:76-79)
+      - same (status, reason) → no-op (status.go:83-86)
+    """
+    # Sticky terminal failure (ref: setCondition "Do nothing if JobStatus
+    # have failed condition").
+    if is_failed(status):
+        return
+    current = get_condition(status, ctype)
+    if current is not None and current.status is True and current.reason == reason:
+        return
+
+    cond = new_condition(ctype, reason, message, status=True)
+
+    if ctype in (JobConditionType.SUCCEEDED, JobConditionType.FAILED):
+        # Terminal: flip Running to False in place (ref: status.go:99-109).
+        running = get_condition(status, JobConditionType.RUNNING)
+        if running is not None and running.status:
+            running.status = False
+            running.last_transition_time = cond.last_transition_time
+            running.last_update_time = cond.last_update_time
+    elif ctype == JobConditionType.RUNNING:
+        _remove_condition(status.conditions, JobConditionType.RESTARTING)
+    elif ctype == JobConditionType.RESTARTING:
+        _remove_condition(status.conditions, JobConditionType.RUNNING)
+
+    _set_condition(status.conditions, cond)
+
+
+def _set_condition(conditions: List[JobCondition], cond: JobCondition) -> None:
+    current = next((c for c in conditions if c.type == cond.type), None)
+    if current is not None:
+        if current.status == cond.status and current.reason == cond.reason:
+            cond.last_transition_time = current.last_transition_time
+        conditions.remove(current)
+    conditions.append(cond)
+
+
+def _remove_condition(conditions: List[JobCondition], ctype: JobConditionType) -> None:
+    conditions[:] = [c for c in conditions if c.type != ctype]

@@ -1,0 +1,123 @@
+"""Pod/Service control: the effect interface of the reconcile engine.
+
+Behavioral contract of the reference's control package
+(/root/reference/vendor/github.com/kubeflow/common/pkg/controller.v1/control/):
+  - RealPodControl/RealServiceControl create/delete objects with the owner
+    reference stamped and emit Events on the owning job
+    (pod_control.go, service_control.go)
+  - Fake controls record intended effects for unit tests without touching the
+    substrate (the whole Tier-1 test strategy hangs off this seam, SURVEY.md §4)
+"""
+from __future__ import annotations
+
+import threading
+from typing import List
+
+from ..api import constants
+from ..api.core import Event, Pod, Service
+from ..api.types import TPUJob
+from .cluster import ClusterInterface
+
+
+class PodControlInterface:
+    def create_pod(self, pod: Pod, job: TPUJob) -> None: ...
+    def delete_pod(self, namespace: str, name: str, job: TPUJob) -> None: ...
+
+
+class ServiceControlInterface:
+    def create_service(self, svc: Service, job: TPUJob) -> None: ...
+    def delete_service(self, namespace: str, name: str, job: TPUJob) -> None: ...
+
+
+def set_owner(meta, job: TPUJob) -> None:
+    """(ref: GenOwnerReference, common/job_controller.go:187-199)"""
+    meta.owner_kind = job.kind
+    meta.owner_name = job.metadata.name
+    meta.owner_uid = job.metadata.uid
+
+
+def _event(job: TPUJob, etype: str, reason: str, message: str) -> Event:
+    return Event(
+        object_kind=job.kind,
+        object_name=job.metadata.name,
+        namespace=job.metadata.namespace,
+        event_type=etype,
+        reason=reason,
+        message=message,
+    )
+
+
+class RealPodControl(PodControlInterface):
+    def __init__(self, cluster: ClusterInterface) -> None:
+        self.cluster = cluster
+
+    def create_pod(self, pod: Pod, job: TPUJob) -> None:
+        set_owner(pod.metadata, job)
+        self.cluster.create_pod(pod)
+        self.cluster.record_event(
+            _event(job, "Normal", "SuccessfulCreatePod", f"Created pod: {pod.metadata.name}")
+        )
+
+    def delete_pod(self, namespace: str, name: str, job: TPUJob) -> None:
+        self.cluster.delete_pod(namespace, name)
+        self.cluster.record_event(
+            _event(job, "Normal", "SuccessfulDeletePod", f"Deleted pod: {name}")
+        )
+
+
+class RealServiceControl(ServiceControlInterface):
+    def __init__(self, cluster: ClusterInterface) -> None:
+        self.cluster = cluster
+
+    def create_service(self, svc: Service, job: TPUJob) -> None:
+        set_owner(svc.metadata, job)
+        self.cluster.create_service(svc)
+        self.cluster.record_event(
+            _event(job, "Normal", "SuccessfulCreateService", f"Created service: {svc.metadata.name}")
+        )
+
+    def delete_service(self, namespace: str, name: str, job: TPUJob) -> None:
+        self.cluster.delete_service(namespace, name)
+        self.cluster.record_event(
+            _event(job, "Normal", "SuccessfulDeleteService", f"Deleted service: {name}")
+        )
+
+
+class FakePodControl(PodControlInterface):
+    """Records intended effects (ref: control/pod_control.go FakePodControl)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.pods: List[Pod] = []
+        self.deleted_pod_names: List[str] = []
+        self.create_error: Exception | None = None
+        self.delete_error: Exception | None = None
+
+    def create_pod(self, pod: Pod, job: TPUJob) -> None:
+        with self._lock:
+            if self.create_error is not None:
+                raise self.create_error
+            set_owner(pod.metadata, job)
+            self.pods.append(pod)
+
+    def delete_pod(self, namespace: str, name: str, job: TPUJob) -> None:
+        with self._lock:
+            if self.delete_error is not None:
+                raise self.delete_error
+            self.deleted_pod_names.append(name)
+
+
+class FakeServiceControl(ServiceControlInterface):
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.services: List[Service] = []
+        self.deleted_service_names: List[str] = []
+
+    def create_service(self, svc: Service, job: TPUJob) -> None:
+        with self._lock:
+            set_owner(svc.metadata, job)
+            self.services.append(svc)
+
+    def delete_service(self, namespace: str, name: str, job: TPUJob) -> None:
+        with self._lock:
+            self.deleted_service_names.append(name)

@@ -1,0 +1,29 @@
+"""Exit-code classification for the ExitCode restart policy.
+
+Behavioral contract of the reference's classifier
+(/root/reference/vendor/github.com/kubeflow/common/pkg/util/train/train_util.go:18-53):
+
+  retryable:  130 (SIGINT), 137 (SIGKILL), 143 (SIGTERM) — exactly the codes a
+              preempted VM produces — plus 138 (SIGUSR1), reserved for
+              user-signalled retryable failures.
+  permanent:  1, 2, 126, 127, 128, 139 — config/usage errors and SIGSEGV.
+  other codes ≥ 129 not listed above are treated as permanent.
+
+TPU note: on preemptible TPU-VM slices the whole gang dies with SIGTERM; the
+classifier is what turns that into a JobRestarting cycle instead of JobFailed.
+"""
+
+RETRYABLE_EXIT_CODES = frozenset({130, 137, 143, 138})
+PERMANENT_EXIT_CODES = frozenset({1, 2, 126, 127, 128, 139})
+
+# Sentinel used when a failed pod carries no terminated container state
+# (ref: pkg/controller.v1/tensorflow/pod.go:124 — 0xbeef default).
+UNKNOWN_EXIT_CODE = 0xBEEF
+
+
+def is_retryable_exit_code(exit_code: int) -> bool:
+    return exit_code in RETRYABLE_EXIT_CODES
+
+
+def is_permanent_exit_code(exit_code: int) -> bool:
+    return not is_retryable_exit_code(exit_code)

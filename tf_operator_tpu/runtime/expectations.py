@@ -1,0 +1,86 @@
+"""Expectations cache: in-flight create/delete accounting.
+
+Behavioral contract of the reference's ControllerExpectations
+(/root/reference/vendor/github.com/kubeflow/common/pkg/controller.v1/expectation/expectation.go):
+  - per-key (job/replica-type/kind) atomic add/del counters (expectation.go:176-195)
+  - SatisfiedExpectations: true when both counters ≤ 0, or the entry has
+    expired (5 min TTL — the informer cache is assumed caught-up by then), or
+    no expectations were ever recorded (expectation.go:93-118)
+  - observations never drive counters negative in effect: fulfilled
+    expectations simply stay satisfied
+
+Why it exists: the controller's view of the cluster (informer cache) lags its
+own writes; without this gate a sync racing its own pod creations would create
+duplicates (SURVEY.md §7 "hard parts").
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+EXPECTATION_TIMEOUT_SECONDS = 5 * 60.0  # ref: expectation.go:24
+
+
+def expectation_key(job_key: str, replica_type: str, kind: str) -> str:
+    """kind is "pods" or "services" (ref: controller.go:339-358 key format)."""
+    return f"{job_key}/{replica_type.lower()}/{kind}"
+
+
+@dataclass
+class _Entry:
+    adds: int = 0
+    dels: int = 0
+    timestamp: float = field(default_factory=time.time)
+
+    def fulfilled(self) -> bool:
+        return self.adds <= 0 and self.dels <= 0
+
+    def expired(self) -> bool:
+        return time.time() - self.timestamp > EXPECTATION_TIMEOUT_SECONDS
+
+
+class Expectations:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._entries: dict[str, _Entry] = {}
+
+    def expect_creations(self, key: str, count: int) -> None:
+        self._set(key, adds=count, dels=0)
+
+    def expect_deletions(self, key: str, count: int) -> None:
+        self._set(key, adds=0, dels=count)
+
+    def _set(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            self._entries[key] = _Entry(adds=adds, dels=dels)
+
+    def raise_expectations(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            entry = self._entries.setdefault(key, _Entry(adds=0, dels=0))
+            entry.adds += adds
+            entry.dels += dels
+
+    def creation_observed(self, key: str) -> None:
+        self._lower(key, adds=1, dels=0)
+
+    def deletion_observed(self, key: str) -> None:
+        self._lower(key, adds=0, dels=1)
+
+    def _lower(self, key: str, adds: int, dels: int) -> None:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry.adds -= adds
+                entry.dels -= dels
+
+    def satisfied(self, key: str) -> bool:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return True
+            return entry.fulfilled() or entry.expired()
+
+    def delete_expectations(self, key: str) -> None:
+        with self._lock:
+            self._entries.pop(key, None)

@@ -1,0 +1,743 @@
+"""Generic job reconcile engine.
+
+This is our own rebuild of the vendored kubeflow/common job-controller runtime
+(/root/reference/vendor/github.com/kubeflow/common/pkg/controller.v1/common/),
+preserving its behavioral contract (SURVEY.md §2.3, §7 stage 2):
+
+  - ReconcileJobs master algorithm (job.go:72-252): terminal-state cleanup
+    ordering → backoff/deadline enforcement → gang sync → per-replica-type pod
+    and service reconciliation → status computation → DeepEqual-guarded write.
+  - Pod "slices" indexed by the replica-index label (pod.go:281-318), create
+    missing indices, delete out-of-range indices (dynamic scale down).
+  - Headless service per replica with the same naming scheme (service.go).
+  - Gang scheduling: PodGroup with MinMember = total replicas, lifecycle tied
+    to job terminal state (job_controller.go:211-239, job.go:117-125).
+
+Job-type-specific behavior (cluster-spec injection, master-role labeling,
+exit-code restarts, success rules) plugs in through `JobPlugin` — the analogue
+of the 15-method ControllerInterface (vendor/.../apis/common/v1/interface.go:10-73).
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..api import constants
+from ..api.core import (
+    Event,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodPhase,
+    Service,
+    ServicePort,
+)
+from ..api.types import (
+    CleanPodPolicy,
+    JobStatus,
+    ReplicaSpec,
+    ReplicaStatus,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+)
+from ..utils import logging as tpulog
+from ..utils import metrics
+from . import conditions
+from .cluster import ClusterInterface, NotFound
+from .control import PodControlInterface, ServiceControlInterface
+from .expectations import Expectations, expectation_key
+
+
+class JobPlugin:
+    """Job-type plugin contract (ref: interface.go:10-73).
+
+    The generic engine calls these hooks; TPUJobController implements them.
+    """
+
+    controller_name: str = "generic-job-controller"
+
+    def set_cluster_spec(self, job: TPUJob, pod: Pod, rtype: ReplicaType, index: int) -> None:
+        """Inject topology env into the pod (ref: SetClusterSpec, tensorflow.go:85-139)."""
+
+    def is_master_role(
+        self, replicas: Dict[ReplicaType, ReplicaSpec], rtype: ReplicaType, index: int
+    ) -> bool:
+        """(ref: controller.go:409-416)"""
+        return False
+
+    def update_job_status(
+        self,
+        job: TPUJob,
+        replicas: Dict[ReplicaType, ReplicaSpec],
+        status: JobStatus,
+        pods: List[Pod],
+        restarting_this_pass: bool,
+    ) -> None:
+        """Compute success/failure/running conditions (ref: status.go:57-204).
+
+        `pods` is the already-listed/claimed pod set of this pass (the
+        reference threads the same view through); `restarting_this_pass` is
+        true iff reconcile_pods deleted a pod for a retryable failure in THIS
+        pass — the per-sync restart signal that suppresses JobFailed."""
+
+    def on_pod_created(self, job: TPUJob, rtype: ReplicaType) -> None:
+        """Metric/event hook."""
+
+    def pod_failed_is_retryable(self, job: TPUJob, rspec: ReplicaSpec, pod: Pod, exit_code: int) -> bool:
+        """Whether an ExitCode-policy failure should trigger a restart."""
+        from .exit_codes import is_retryable_exit_code
+
+        return is_retryable_exit_code(exit_code)
+
+
+@dataclass
+class ReconcilerConfig:
+    """(ref: JobControllerConfiguration, job_controller.go:60-77)"""
+
+    reconciler_sync_loop_period: float = 15.0
+    enable_gang_scheduling: bool = False
+    gang_scheduler_name: str = constants.GANG_SCHEDULER_NAME
+
+
+@dataclass
+class ReconcileResult:
+    """What a sync decided, for observability/tests."""
+
+    terminal: bool = False
+    failed_reason: str = ""
+    requeue_after: Optional[float] = None
+
+
+def gen_labels(job_name: str) -> Dict[str, str]:
+    """(ref: GenLabels, job_controller.go:201-209 — '/' replaced with '-')"""
+    return {
+        constants.LABEL_GROUP_NAME: constants.API_GROUP,
+        constants.LABEL_JOB_NAME: job_name.replace("/", "-"),
+    }
+
+
+def gen_general_name(job_name: str, rtype: str, index: int) -> str:
+    """Pod/service naming '<job>-<rtype>-<index>' (ref: common/pod.go:447)."""
+    return f"{job_name}-{rtype.lower()}-{index}".replace("/", "-")
+
+
+def calculate_pod_slice_size(pods: List[Pod], replicas: int) -> int:
+    """(ref: calculatePodSliceSize, common/pod.go:303-318)"""
+    size = 0
+    for pod in pods:
+        try:
+            index = int(pod.metadata.labels.get(constants.LABEL_REPLICA_INDEX, -1))
+        except ValueError:
+            continue
+        size = max(size, index + 1)
+    return max(size, replicas)
+
+
+def _index_slices(objs, replicas: int):
+    """Bucket labeled objects by replica-index into a list sized
+    max(maxIndex+1, replicas) (ref: GetPodSlices common/pod.go:281-300 and
+    GetServiceSlices common/service.go:166-200 — one shared impl here)."""
+    slices = [[] for _ in range(calculate_pod_slice_size(objs, replicas))]
+    for obj in objs:
+        raw = obj.metadata.labels.get(constants.LABEL_REPLICA_INDEX)
+        try:
+            index = int(raw)
+        except (TypeError, ValueError):
+            continue
+        if 0 <= index < len(slices):
+            slices[index].append(obj)
+    return slices
+
+
+def get_pod_slices(pods: List[Pod], replicas: int) -> List[List[Pod]]:
+    return _index_slices(pods, replicas)
+
+
+def get_service_slices(services: List[Service], replicas: int) -> List[List[Service]]:
+    return _index_slices(services, replicas)
+
+
+def filter_for_replica_type(objs, rtype: ReplicaType):
+    """(ref: FilterPodsForReplicaType, common/pod.go:257-276)"""
+    want = rtype.value.lower()
+    return [
+        o
+        for o in objs
+        if o.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "").lower() == want
+    ]
+
+
+def get_port_from_job(spec: TPUJobSpec, rtype: ReplicaType) -> int:
+    """Port of the well-known container port (ref: GetPortFromJob,
+    service.go:256-274; pkg/.../util.go:29-42)."""
+    rspec = spec.replica_specs.get(rtype)
+    if rspec is not None:
+        container = rspec.template.container(
+            constants.DEFAULT_CONTAINER_NAME, constants.ALT_CONTAINER_NAME
+        )
+        if container is not None:
+            for port in container.ports:
+                if port.name == constants.DEFAULT_PORT_NAME:
+                    return port.container_port
+    return constants.DEFAULT_PORT
+
+
+def update_job_replica_statuses(status: JobStatus, rtype: ReplicaType, pod: Pod) -> None:
+    """(ref: updateJobReplicaStatuses, common/pod.go + initializeReplicaStatuses)"""
+    rs = status.replica_statuses.setdefault(rtype.value, ReplicaStatus())
+    if pod.status.phase == PodPhase.RUNNING:
+        rs.active += 1
+    elif pod.status.phase == PodPhase.SUCCEEDED:
+        rs.succeeded += 1
+    elif pod.status.phase == PodPhase.FAILED:
+        rs.failed += 1
+
+
+def get_container_exit_code(pod: Pod, container_names=(
+    constants.DEFAULT_CONTAINER_NAME, constants.ALT_CONTAINER_NAME
+)) -> int:
+    """Terminated exit code of the operator container, 0xbeef if unknown
+    (ref: pkg/controller.v1/tensorflow/pod.go:124-133)."""
+    from .exit_codes import UNKNOWN_EXIT_CODE
+
+    for cs in pod.status.container_statuses:
+        if cs.name in container_names and cs.terminated and cs.exit_code is not None:
+            return cs.exit_code
+    return UNKNOWN_EXIT_CODE
+
+
+class JobReconciler:
+    """The generic engine (ref: JobController, common/job_controller.go:83-140)."""
+
+    def __init__(
+        self,
+        cluster: ClusterInterface,
+        pod_control: PodControlInterface,
+        service_control: ServiceControlInterface,
+        plugin: JobPlugin,
+        config: Optional[ReconcilerConfig] = None,
+    ) -> None:
+        self.cluster = cluster
+        self.pod_control = pod_control
+        self.service_control = service_control
+        self.plugin = plugin
+        self.config = config or ReconcilerConfig()
+        self.expectations = Expectations()
+
+    # ------------------------------------------------------------------
+    # object ownership
+
+    def get_pods_for_job(self, job: TPUJob) -> List[Pod]:
+        """Label-selected pods, claimed by owner UID; orphans with matching
+        labels are adopted (ref: GetPodsForJob + ControllerRefManager,
+        common/pod.go:219-254)."""
+        selector = gen_labels(job.metadata.name)
+        pods = self.cluster.list_pods(namespace=job.metadata.namespace, selector=selector)
+        claimed = []
+        for pod in pods:
+            if not pod.metadata.owner_uid:
+                # adopt
+                pod.metadata.owner_kind = job.kind
+                pod.metadata.owner_name = job.metadata.name
+                pod.metadata.owner_uid = job.metadata.uid
+                claimed.append(pod)
+            elif pod.metadata.controlled_by(job.kind, job.metadata.uid):
+                claimed.append(pod)
+        return claimed
+
+    def get_services_for_job(self, job: TPUJob) -> List[Service]:
+        selector = gen_labels(job.metadata.name)
+        services = self.cluster.list_services(
+            namespace=job.metadata.namespace, selector=selector
+        )
+        return [
+            s
+            for s in services
+            if not s.metadata.owner_uid or s.metadata.controlled_by(job.kind, job.metadata.uid)
+        ]
+
+    # ------------------------------------------------------------------
+    # the master algorithm (ref: ReconcileJobs, common/job.go:72-252)
+
+    def reconcile_job(self, job: TPUJob) -> ReconcileResult:
+        log = tpulog.logger_for_job(job)
+        old_status = _snapshot_status(job.status)
+        job.status.last_reconcile_time = time.time()
+        result = ReconcileResult()
+
+        pods = self.get_pods_for_job(job)
+        services = self.get_services_for_job(job)
+        replicas = job.spec.replica_specs
+
+        if conditions.is_finished(job.status):
+            # Terminal: cleanup, flip active counts, write status once.
+            # (ref: job.go:107-143)
+            self.delete_pods_and_services(job, pods)
+            ttl = job.spec.run_policy.ttl_seconds_after_finished
+            ttl_remaining = self.cleanup_job(job)
+            if ttl is not None and ttl_remaining is None:
+                # TTL expired: the job object itself was just deleted.
+                result.terminal = True
+                return result
+            if ttl_remaining is not None:
+                # Re-sync when the TTL expires (ref: job.go:316-323 requeue).
+                result.requeue_after = ttl_remaining
+            if self.config.enable_gang_scheduling:
+                self.delete_podgroup(job)
+            if conditions.is_succeeded(job.status):
+                for rs in job.status.replica_statuses.values():
+                    rs.succeeded += rs.active
+                    rs.active = 0
+            result.terminal = True
+            self._write_status_if_changed(job, old_status)
+            return result
+
+        # Job-level limits (ref: job.go:159-214).
+        failure_reason = ""
+        failure_message = ""
+        if self.past_backoff_limit(job, pods):
+            failure_reason = "BackoffLimitExceeded"
+            failure_message = f"TPUJob {job.metadata.name} has failed because it has reached the specified backoff limit"
+        elif self.past_active_deadline(job):
+            failure_reason = "DeadlineExceeded"
+            failure_message = f"TPUJob {job.metadata.name} has failed because it was active longer than specified deadline"
+
+        if failure_reason:
+            self.cluster.record_event(
+                Event(
+                    object_kind=job.kind,
+                    object_name=job.metadata.name,
+                    namespace=job.metadata.namespace,
+                    event_type="Warning",
+                    reason=failure_reason,
+                    message=failure_message,
+                )
+            )
+            self.delete_pods_and_services(job, pods)
+            if self.config.enable_gang_scheduling:
+                self.delete_podgroup(job)
+            conditions.update_job_conditions(
+                job.status, conditions.JobConditionType.FAILED, failure_reason, failure_message
+            )
+            if job.status.completion_time is None:
+                job.status.completion_time = time.time()
+            metrics.jobs_failed.labels().inc()
+            result.terminal = True
+            result.failed_reason = failure_reason
+            self._write_status_if_changed(job, old_status)
+            return result
+
+        # Gang scheduling: ensure the PodGroup exists before any pod
+        # (ref: job.go:217-223; all-or-nothing slice allocation).
+        if self.config.enable_gang_scheduling:
+            self.sync_podgroup(job)
+
+        # Fresh replica-status accounting for this pass
+        # (ref: initializeReplicaStatuses, common/status.go).
+        job.status.replica_statuses = {}
+        for rtype in replicas:
+            job.status.replica_statuses[rtype.value] = ReplicaStatus()
+
+        restarting_this_pass = False
+        for rtype, rspec in replicas.items():
+            if self.reconcile_pods(job, pods, rtype, rspec, replicas):
+                restarting_this_pass = True
+            self.reconcile_services(job, services, rtype, rspec)
+
+        self.plugin.update_job_status(
+            job, replicas, job.status, pods, restarting_this_pass
+        )
+        self._write_status_if_changed(job, old_status)
+        # ActiveDeadlineSeconds enforcement is scheduled once when start_time
+        # is first set (plugin hook → workqueue.add_after, ref: status.go:78-86)
+        # and backstopped by the controller's periodic resync loop.
+        log.debug("reconcile complete")
+        return result
+
+    # ------------------------------------------------------------------
+    # pods (ref: TF override ReconcilePods, pkg/.../pod.go:64-160, atop
+    # common/pod.go slice machinery)
+
+    def reconcile_pods(
+        self,
+        job: TPUJob,
+        all_pods: List[Pod],
+        rtype: ReplicaType,
+        rspec: ReplicaSpec,
+        replicas: Dict[ReplicaType, ReplicaSpec],
+    ) -> bool:
+        """Returns True if a retryable-failure restart happened this pass."""
+        log = tpulog.logger_for_replica(job, rtype)
+        pods = filter_for_replica_type(all_pods, rtype)
+        num_replicas = int(rspec.replicas or 0)
+        slices = get_pod_slices(pods, num_replicas)
+        gang_restart = False
+        restarted = False
+        deleted_names = set()
+
+        def delete(pod: Pod) -> None:
+            self._delete_pod(job, rtype, pod)
+            deleted_names.add(pod.metadata.name)
+
+        for index, pod_slice in enumerate(slices):
+            if len(pod_slice) > 1:
+                # Never expected: slice invariant broken; keep the oldest
+                # (ref: common/pod.go logs "more than one pod").
+                log.warning("more than one pod found at index %d; deleting extras", index)
+                for extra in sorted(pod_slice, key=lambda p: p.metadata.creation_timestamp)[1:]:
+                    delete(extra)
+                pod_slice = [min(pod_slice, key=lambda p: p.metadata.creation_timestamp)]
+
+            if index >= num_replicas:
+                # Scale down: out-of-range index (ref: pkg/.../pod.go:93-123).
+                for pod in pod_slice:
+                    delete(pod)
+                continue
+
+            if not pod_slice:
+                self.create_new_pod(job, rtype, rspec, index, replicas)
+                continue
+
+            pod = pod_slice[0]
+            exit_code = get_container_exit_code(pod)
+            if pod.status.phase == PodPhase.FAILED and exit_code != 0:
+                from .exit_codes import UNKNOWN_EXIT_CODE
+
+                if exit_code != UNKNOWN_EXIT_CODE:
+                    self.cluster.record_event(
+                        Event(
+                            object_kind=job.kind,
+                            object_name=job.metadata.name,
+                            namespace=job.metadata.namespace,
+                            event_type="Normal",
+                            reason="ExitedWithCode",
+                            message=f"Pod: {pod.metadata.namespace}.{pod.metadata.name} exited with code {exit_code}",
+                        )
+                    )
+
+            if (
+                rspec.restart_policy == RestartPolicy.EXIT_CODE
+                and pod.status.phase == PodPhase.FAILED
+                and self.plugin.pod_failed_is_retryable(job, rspec, pod, exit_code)
+            ):
+                # Retryable failure: delete; recreated next sync by slice diff.
+                # Also surfaces the JobRestarting condition — the TF-specific
+                # addition over common (ref: pkg/.../pod.go:135-154).
+                log.info("restarting pod %s (exit code %d)", pod.metadata.name, exit_code)
+                delete(pod)
+                restarted = True
+                conditions.update_job_conditions(
+                    job.status,
+                    conditions.JobConditionType.RESTARTING,
+                    "JobRestarting",
+                    f"TPUJob {job.metadata.name} is restarting because {rtype.value} replica {index} exited with retryable code {exit_code}",
+                )
+                metrics.jobs_restarted.labels().inc()
+                metrics.restarted_pods.labels().inc()
+                if rspec.tpu is not None and rspec.tpu.topology:
+                    gang_restart = True
+
+            update_job_replica_statuses(job.status, rtype, pod)
+
+        if gang_restart:
+            # TPU gang restart (no reference analogue — SURVEY.md §7 "hard
+            # parts"): one dead host leaves the slice's ICI ring broken, so
+            # surviving hosts of this replica group are restarted with it.
+            for pod in pods:
+                if pod.metadata.name in deleted_names:
+                    continue
+                if pod.status.phase in (PodPhase.RUNNING, PodPhase.PENDING):
+                    log.info("gang restart: deleting sibling pod %s", pod.metadata.name)
+                    delete(pod)
+                    metrics.restarted_pods.labels().inc()
+        return restarted
+
+    def create_new_pod(
+        self,
+        job: TPUJob,
+        rtype: ReplicaType,
+        rspec: ReplicaSpec,
+        index: int,
+        replicas: Dict[ReplicaType, ReplicaSpec],
+    ) -> None:
+        """(ref: createNewPod, pkg/.../pod.go:163-247)"""
+        import copy as _copy
+
+        job_key = job.key()
+        self.expectations.raise_expectations(
+            expectation_key(job_key, rtype.value, "pods"), adds=1, dels=0
+        )
+
+        labels = gen_labels(job.metadata.name)
+        labels[constants.LABEL_REPLICA_TYPE] = rtype.value.lower()
+        labels[constants.LABEL_REPLICA_INDEX] = str(index)
+        if self.plugin.is_master_role(replicas, rtype, index):
+            labels[constants.LABEL_JOB_ROLE] = constants.JOB_ROLE_MASTER
+
+        template = _copy.deepcopy(rspec.template)
+        template.metadata.labels.update(labels)
+
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=gen_general_name(job.metadata.name, rtype.value, index),
+                namespace=job.metadata.namespace,
+                labels=dict(template.metadata.labels),
+                annotations=dict(template.metadata.annotations),
+            ),
+            spec=template,
+        )
+
+        self.plugin.set_cluster_spec(job, pod, rtype, index)
+        _set_restart_policy(pod, rspec)
+
+        if self.config.enable_gang_scheduling:
+            # (ref: pod.go:218-231 — scheduler name + group annotation)
+            if not pod.spec.scheduler_name:
+                pod.spec.scheduler_name = self.config.gang_scheduler_name
+            pod.metadata.annotations[constants.GANG_GROUP_ANNOTATION] = job.metadata.name
+
+        try:
+            self.pod_control.create_pod(pod, job)
+        except Exception:
+            self.expectations.creation_observed(expectation_key(job_key, rtype.value, "pods"))
+            raise
+        metrics.created_pods.labels().inc()
+        self.plugin.on_pod_created(job, rtype)
+
+    def _delete_pod(self, job: TPUJob, rtype: ReplicaType, pod: Pod) -> None:
+        self.expectations.raise_expectations(
+            expectation_key(job.key(), rtype.value, "pods"), adds=0, dels=1
+        )
+        try:
+            self.pod_control.delete_pod(pod.metadata.namespace, pod.metadata.name, job)
+        except Exception:
+            self.expectations.deletion_observed(expectation_key(job.key(), rtype.value, "pods"))
+            raise
+        metrics.deleted_pods.labels().inc()
+
+    # ------------------------------------------------------------------
+    # services (ref: common/service.go:206-339)
+
+    def reconcile_services(
+        self, job: TPUJob, all_services: List[Service], rtype: ReplicaType, rspec: ReplicaSpec
+    ) -> None:
+        services = filter_for_replica_type(all_services, rtype)
+        num_replicas = int(rspec.replicas or 0)
+        slices = get_service_slices(services, num_replicas)
+
+        for index, svc_slice in enumerate(slices):
+            if index >= num_replicas:
+                for svc in svc_slice:
+                    self._delete_service(job, rtype, svc)
+                continue
+            if not svc_slice:
+                self.create_new_service(job, rtype, rspec, index)
+
+    def create_new_service(
+        self, job: TPUJob, rtype: ReplicaType, rspec: ReplicaSpec, index: int
+    ) -> None:
+        """Headless service for one replica (ref: CreateNewService,
+        common/service.go:277-339)."""
+        self.expectations.raise_expectations(
+            expectation_key(job.key(), rtype.value, "services"), adds=1, dels=0
+        )
+        labels = gen_labels(job.metadata.name)
+        labels[constants.LABEL_REPLICA_TYPE] = rtype.value.lower()
+        labels[constants.LABEL_REPLICA_INDEX] = str(index)
+        port = get_port_from_job(job.spec, rtype)
+        svc = Service(
+            metadata=ObjectMeta(
+                name=gen_general_name(job.metadata.name, rtype.value, index),
+                namespace=job.metadata.namespace,
+                labels=dict(labels),
+            ),
+            selector=dict(labels),
+            ports=[ServicePort(name=constants.DEFAULT_PORT_NAME, port=port)],
+            cluster_ip="None",
+        )
+        try:
+            self.service_control.create_service(svc, job)
+        except Exception:
+            self.expectations.creation_observed(
+                expectation_key(job.key(), rtype.value, "services")
+            )
+            raise
+        metrics.created_services.labels().inc()
+
+    def _delete_service(self, job: TPUJob, rtype: ReplicaType, svc: Service) -> None:
+        self.expectations.raise_expectations(
+            expectation_key(job.key(), rtype.value, "services"), adds=0, dels=1
+        )
+        try:
+            self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
+        except Exception:
+            self.expectations.deletion_observed(
+                expectation_key(job.key(), rtype.value, "services")
+            )
+            raise
+        metrics.deleted_services.labels().inc()
+
+    # ------------------------------------------------------------------
+    # terminal cleanup (ref: DeletePodsAndServices, common/job.go:19-42;
+    # CleanupJob TTL, job.go:307-330)
+
+    def delete_pods_and_services(self, job: TPUJob, pods: List[Pod]) -> None:
+        policy = job.spec.run_policy.clean_pod_policy or CleanPodPolicy.RUNNING
+        if policy == CleanPodPolicy.NONE:
+            return
+        for pod in pods:
+            if policy == CleanPodPolicy.RUNNING and pod.status.phase not in (
+                PodPhase.RUNNING,
+                PodPhase.PENDING,
+            ):
+                continue
+            rtype_raw = pod.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
+            rtype = _replica_type_from_label(rtype_raw)
+            if rtype is not None:
+                self._delete_pod(job, rtype, pod)
+            else:
+                self.pod_control.delete_pod(pod.metadata.namespace, pod.metadata.name, job)
+        # Services always go with the job's pods (ref: job.go:33-40 deletes
+        # services regardless of policy once pods are handled).
+        for svc in self.get_services_for_job(job):
+            rtype = _replica_type_from_label(
+                svc.metadata.labels.get(constants.LABEL_REPLICA_TYPE, "")
+            )
+            if rtype is not None:
+                self._delete_service(job, rtype, svc)
+            else:
+                self.service_control.delete_service(svc.metadata.namespace, svc.metadata.name, job)
+
+    def cleanup_job(self, job: TPUJob) -> Optional[float]:
+        """TTLSecondsAfterFinished: delete the job once expired; returns the
+        remaining delay if not yet due (ref: CleanupJob, job.go:307-330)."""
+        ttl = job.spec.run_policy.ttl_seconds_after_finished
+        if ttl is None:
+            return None
+        finish_time = job.status.completion_time or time.time()
+        expires_at = finish_time + ttl
+        remaining = expires_at - time.time()
+        if remaining <= 0:
+            try:
+                self.cluster.delete_job(job.metadata.namespace, job.metadata.name)
+                metrics.jobs_deleted.labels().inc()
+            except NotFound:
+                pass
+            return None
+        return remaining
+
+    # ------------------------------------------------------------------
+    # gang scheduling (ref: SyncPodGroup/DeletePodGroup,
+    # common/job_controller.go:211-239,280-298)
+
+    def sync_podgroup(self, job: TPUJob) -> PodGroup:
+        from ..api.defaults import total_replicas
+
+        sp = job.spec.run_policy.scheduling_policy
+        min_member = (
+            sp.min_available
+            if sp is not None and sp.min_available is not None
+            else total_replicas(job)
+        )
+        try:
+            return self.cluster.get_podgroup(job.metadata.namespace, job.metadata.name)
+        except NotFound:
+            pg = PodGroup(
+                metadata=ObjectMeta(
+                    name=job.metadata.name,
+                    namespace=job.metadata.namespace,
+                    owner_kind=job.kind,
+                    owner_name=job.metadata.name,
+                    owner_uid=job.metadata.uid,
+                ),
+                min_member=min_member,
+                queue=sp.queue if sp is not None else "",
+            )
+            created = self.cluster.create_podgroup(pg)
+            metrics.created_podgroups.labels().inc()
+            return created
+
+    def delete_podgroup(self, job: TPUJob) -> None:
+        try:
+            self.cluster.delete_podgroup(job.metadata.namespace, job.metadata.name)
+            metrics.deleted_podgroups.labels().inc()
+        except NotFound:
+            pass
+
+    # ------------------------------------------------------------------
+    # job-level limits
+
+    def past_active_deadline(self, job: TPUJob) -> bool:
+        """(ref: PastActiveDeadline, common/job.go:255-264)"""
+        deadline = job.spec.run_policy.active_deadline_seconds
+        if deadline is None or job.status.start_time is None:
+            return False
+        return time.time() - job.status.start_time >= deadline
+
+    def past_backoff_limit(self, job: TPUJob, pods: List[Pod]) -> bool:
+        """Sum container restart counts of Running pods over restartable
+        replicas; limit 0 means any restart fails the job
+        (ref: PastBackoffLimit, common/job.go:268-305)."""
+        limit = job.spec.run_policy.backoff_limit
+        if limit is None:
+            return False
+        restarts = 0
+        for rtype, rspec in job.spec.replica_specs.items():
+            if rspec.restart_policy not in (RestartPolicy.ALWAYS, RestartPolicy.ON_FAILURE):
+                # Only in-place kubelet restarts count toward backoff
+                # (ref: job.go:275-278).
+                continue
+            for pod in filter_for_replica_type(pods, rtype):
+                if pod.status.phase != PodPhase.RUNNING:
+                    continue  # (ref: job.go:287-289)
+                for cs in pod.status.container_statuses:
+                    restarts += cs.restart_count
+        if limit == 0:
+            return restarts > 0
+        return restarts >= limit
+
+    # ------------------------------------------------------------------
+
+    def _write_status_if_changed(self, job: TPUJob, old_status_snapshot) -> None:
+        """DeepEqual status-write guard (ref: job.go:248-250, status.go:207-225)."""
+        if _snapshot_status(job.status) != old_status_snapshot:
+            self.cluster.update_job_status(
+                job.metadata.namespace, job.metadata.name, job.status
+            )
+
+
+def _set_restart_policy(pod: Pod, rspec: ReplicaSpec) -> None:
+    """ExitCode policy maps to substrate 'Never' — the controller owns the
+    restart decision (ref: setRestartPolicy, pkg/.../pod.go:310-317)."""
+    if rspec.restart_policy == RestartPolicy.EXIT_CODE:
+        pod.spec.restart_policy = "Never"
+    else:
+        pod.spec.restart_policy = (rspec.restart_policy or RestartPolicy.NEVER).value
+
+
+def _replica_type_from_label(raw: str) -> Optional[ReplicaType]:
+    for rt in ReplicaType:
+        if rt.value.lower() == raw.lower():
+            return rt
+    return None
+
+
+def _snapshot_status(status: JobStatus):
+    """Hashable deep snapshot for the DeepEqual guard (times that only tick,
+    like last_reconcile_time, are excluded)."""
+    return (
+        tuple(
+            (c.type, c.status, c.reason, c.message) for c in status.conditions
+        ),
+        tuple(
+            sorted(
+                (k, v.active, v.succeeded, v.failed)
+                for k, v in status.replica_statuses.items()
+            )
+        ),
+        status.start_time,
+        status.completion_time,
+    )

@@ -1,0 +1,122 @@
+"""Rate-limited, deduplicating work queue.
+
+Behavioral contract of client-go's workqueue as the reference uses it
+(/root/reference/vendor/github.com/kubeflow/common/pkg/controller.v1/common/job_controller.go:129-135):
+  - add(key) is idempotent while the key is queued (dedup)
+  - a key being processed by one worker is never handed to another; if
+    re-added meanwhile it is redelivered after done() (this is what makes
+    per-job reconciles single-threaded without explicit locks — SURVEY.md §5
+    race-detection notes)
+  - add_rate_limited(key) applies per-key exponential backoff
+    (base 5ms → max 1000s, client-go defaults)
+  - add_after(key, delay) schedules a future enqueue (used to re-arm
+    ActiveDeadlineSeconds, ref: pkg/controller.v1/tensorflow/job.go:153-168)
+  - forget(key) resets the key's backoff
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional, Set
+
+
+class ShutDown(Exception):
+    pass
+
+
+class RateLimitingQueue:
+    def __init__(
+        self, base_delay: float = 0.005, max_delay: float = 1000.0
+    ) -> None:
+        self._cond = threading.Condition()
+        self._queue: deque[str] = deque()
+        self._dirty: Set[str] = set()
+        self._processing: Set[str] = set()
+        self._failures: Dict[str, int] = {}
+        self._base_delay = base_delay
+        self._max_delay = max_delay
+        self._shutting_down = False
+        self._timers: Set[threading.Timer] = set()
+
+    # --- core queue semantics ---
+
+    def add(self, key: str) -> None:
+        with self._cond:
+            if self._shutting_down or key in self._dirty:
+                return
+            self._dirty.add(key)
+            if key not in self._processing:
+                self._queue.append(key)
+                self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> str:
+        """Block until a key is available; raises ShutDown when drained."""
+        with self._cond:
+            deadline = None if timeout is None else time.time() + timeout
+            while not self._queue:
+                if self._shutting_down:
+                    raise ShutDown()
+                remaining = None if deadline is None else deadline - time.time()
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError()
+                self._cond.wait(timeout=remaining)
+            key = self._queue.popleft()
+            self._processing.add(key)
+            self._dirty.discard(key)
+            return key
+
+    def done(self, key: str) -> None:
+        with self._cond:
+            self._processing.discard(key)
+            if key in self._dirty:
+                self._queue.append(key)
+                self._cond.notify()
+
+    # --- rate limiting ---
+
+    def num_requeues(self, key: str) -> int:
+        with self._cond:
+            return self._failures.get(key, 0)
+
+    def add_rate_limited(self, key: str) -> None:
+        with self._cond:
+            failures = self._failures.get(key, 0)
+            self._failures[key] = failures + 1
+        delay = min(self._base_delay * (2**failures), self._max_delay)
+        self.add_after(key, delay)
+
+    def forget(self, key: str) -> None:
+        with self._cond:
+            self._failures.pop(key, None)
+
+    def add_after(self, key: str, delay: float) -> None:
+        if delay <= 0:
+            self.add(key)
+            return
+        timer: threading.Timer = threading.Timer(delay, lambda: self._timer_fire(key, timer))
+        timer.daemon = True
+        with self._cond:
+            if self._shutting_down:
+                return
+            self._timers.add(timer)
+        timer.start()
+
+    def _timer_fire(self, key: str, timer: threading.Timer) -> None:
+        with self._cond:
+            self._timers.discard(timer)
+        self.add(key)
+
+    # --- lifecycle ---
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutting_down = True
+            for t in self._timers:
+                t.cancel()
+            self._timers.clear()
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)

@@ -1,0 +1,71 @@
+"""Structured logging helpers.
+
+The reference attaches job/uid/replica-type fields to every log line via
+logrus (/root/reference/vendor/github.com/kubeflow/common/pkg/util/logger.go:26-96)
+and supports a JSON log format flag (cmd/tf-operator.v1/main.go:58-61).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Any, Dict
+
+
+class JsonFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        payload: Dict[str, Any] = {
+            "level": record.levelname.lower(),
+            "msg": record.getMessage(),
+            "time": self.formatTime(record),
+            "logger": record.name,
+        }
+        payload.update(getattr(record, "fields", {}))
+        return json.dumps(payload)
+
+
+class TextFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        fields = getattr(record, "fields", {})
+        suffix = " ".join(f"{k}={v}" for k, v in fields.items())
+        base = f"{self.formatTime(record)} {record.levelname} {record.name}: {record.getMessage()}"
+        return f"{base} [{suffix}]" if suffix else base
+
+
+def configure(json_format: bool = False, level: int = logging.INFO) -> None:
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(JsonFormatter() if json_format else TextFormatter())
+    root = logging.getLogger("tpu_operator")
+    root.handlers[:] = [handler]
+    root.setLevel(level)
+    root.propagate = False
+
+
+class FieldLogger(logging.LoggerAdapter):
+    def process(self, msg, kwargs):
+        extra = kwargs.setdefault("extra", {})
+        extra.setdefault("fields", {}).update(self.extra)
+        return msg, kwargs
+
+
+def logger_for_job(job) -> FieldLogger:
+    """(ref: util/logger.go LoggerForJob)"""
+    return FieldLogger(
+        logging.getLogger("tpu_operator"),
+        {"job": f"{job.metadata.namespace}.{job.metadata.name}", "uid": job.metadata.uid},
+    )
+
+
+def logger_for_replica(job, rtype) -> FieldLogger:
+    return FieldLogger(
+        logging.getLogger("tpu_operator"),
+        {
+            "job": f"{job.metadata.namespace}.{job.metadata.name}",
+            "uid": job.metadata.uid,
+            "replica-type": str(getattr(rtype, "value", rtype)),
+        },
+    )
+
+
+def logger_for_key(key: str) -> FieldLogger:
+    return FieldLogger(logging.getLogger("tpu_operator"), {"job": key})

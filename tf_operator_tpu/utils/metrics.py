@@ -1,0 +1,143 @@
+"""Minimal Prometheus-compatible metrics registry.
+
+The reference uses promauto counters + promhttp
+(/root/reference/cmd/tf-operator.v1/main.go:39-50 and counter definitions at
+pkg/controller.v1/tensorflow/job.go:29-33, controller.go:66-69, status.go:47-55,
+pod.go:56-60).  prometheus_client is not a guaranteed dependency here, so this
+module implements the subset we need: counters and gauges with label sets,
+rendered in the Prometheus text exposition format.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Tuple
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, kind: str, label_names: Iterable[str] = ()):
+        self.name = name
+        self.help = help_text
+        self.kind = kind
+        self.label_names = tuple(label_names)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *label_values: str) -> "_Child":
+        if len(label_values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, got {label_values}"
+            )
+        return _Child(self, tuple(str(v) for v in label_values))
+
+    def _add(self, key: Tuple[str, ...], amount: float) -> None:
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def _set(self, key: Tuple[str, ...], value: float) -> None:
+        with self._lock:
+            self._values[key] = value
+
+    def value(self, *label_values: str) -> float:
+        with self._lock:
+            return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items and not self.label_names:
+            items = [((), 0.0)]
+        for key, val in items:
+            if key:
+                labels = ",".join(
+                    f'{n}="{v}"' for n, v in zip(self.label_names, key)
+                )
+                lines.append(f"{self.name}{{{labels}}} {val}")
+            else:
+                lines.append(f"{self.name} {val}")
+        return "\n".join(lines)
+
+
+class _Child:
+    def __init__(self, metric: _Metric, key: Tuple[str, ...]):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._metric._add(self._key, amount)
+
+    def set(self, value: float) -> None:
+        self._metric._set(self._key, value)
+
+    def get(self) -> float:
+        return self._metric.value(*self._key)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_text: str, label_names: Iterable[str] = ()) -> _Metric:
+        return self._register(name, help_text, "counter", label_names)
+
+    def gauge(self, name: str, help_text: str, label_names: Iterable[str] = ()) -> _Metric:
+        return self._register(name, help_text, "gauge", label_names)
+
+    def _register(self, name, help_text, kind, label_names) -> _Metric:
+        with self._lock:
+            metric = self._metrics.get(name)
+            if metric is None:
+                metric = _Metric(name, help_text, kind, label_names)
+                self._metrics[name] = metric
+            return metric
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return "\n".join(m.render() for m in metrics) + "\n"
+
+
+REGISTRY = Registry()
+
+# Counters mirroring the reference's metric set (names keep the reference's
+# shape with a tpu_operator_ prefix).
+jobs_created = REGISTRY.counter(
+    "tpu_operator_jobs_created_total", "Counts number of TPU jobs created"
+)
+jobs_deleted = REGISTRY.counter(
+    "tpu_operator_jobs_deleted_total", "Counts number of TPU jobs deleted"
+)
+jobs_successful = REGISTRY.counter(
+    "tpu_operator_jobs_successful_total", "Counts number of TPU jobs successful"
+)
+jobs_failed = REGISTRY.counter(
+    "tpu_operator_jobs_failed_total", "Counts number of TPU jobs failed"
+)
+jobs_restarted = REGISTRY.counter(
+    "tpu_operator_jobs_restarted_total", "Counts number of TPU jobs restarted"
+)
+created_pods = REGISTRY.counter(
+    "tpu_operator_created_pods_total", "Counts number of pods created"
+)
+deleted_pods = REGISTRY.counter(
+    "tpu_operator_deleted_pods_total", "Counts number of pods deleted"
+)
+restarted_pods = REGISTRY.counter(
+    "tpu_operator_restarted_pods_total", "Counts number of pods restarted"
+)
+created_services = REGISTRY.counter(
+    "tpu_operator_created_services_total", "Counts number of services created"
+)
+deleted_services = REGISTRY.counter(
+    "tpu_operator_deleted_services_total", "Counts number of services deleted"
+)
+created_podgroups = REGISTRY.counter(
+    "tpu_operator_created_podgroups_total", "Counts number of podgroups created"
+)
+deleted_podgroups = REGISTRY.counter(
+    "tpu_operator_deleted_podgroups_total", "Counts number of podgroups deleted"
+)
+is_leader = REGISTRY.gauge(
+    "tpu_operator_is_leader", "Whether this operator instance is the leader"
+)
