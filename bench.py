@@ -1,0 +1,117 @@
+"""Benchmark: ResNet-50 training throughput on the attached TPU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline semantics (BASELINE.md): the reference publishes no numbers; the
+driver target is >= 90% of bare-XLA steps/sec for the same model/batch on
+the same chip.  So vs_baseline = framework_steps_per_sec / bare_xla_steps_per_sec,
+where the bare-XLA baseline is a hand-written jit train step with no
+framework abstractions (same math, same data).  >= 0.9 passes; ~1.0 means
+the framework adds no overhead.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BATCH = int(os.environ.get("BENCH_BATCH", "128"))
+IMAGE = 224
+STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+WARMUP = 3
+
+
+def _throughput(step_fn, state, batch, steps: int) -> float:
+    for _ in range(WARMUP):
+        state, metrics = step_fn(state, batch)
+    jax_block(metrics)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+    jax_block(metrics)
+    return steps / (time.perf_counter() - t0)
+
+
+def jax_block(tree):
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(tree):
+        leaf.block_until_ready()
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tf_operator_tpu.models.resnet import ResNet50
+    from tf_operator_tpu.train.state import create_train_state
+    from tf_operator_tpu.train.step import classification_loss_fn, make_train_step
+
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.randn(BATCH, IMAGE, IMAGE, 3), jnp.bfloat16)
+    labels = jnp.asarray(rng.randint(0, 1000, BATCH), jnp.int32)
+    batch = {"x": images, "label": labels}
+
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    tx = optax.sgd(0.1, momentum=0.9)
+
+    # --- framework path ---
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tx, jnp.zeros((2, IMAGE, IMAGE, 3), jnp.bfloat16),
+        init_kwargs={"train": True},
+    )
+    fw_step = make_train_step(
+        classification_loss_fn(model.apply, has_batch_stats=True,
+                               model_kwargs={"train": True}),
+        has_batch_stats=True,
+    )
+    fw_sps = _throughput(fw_step, state, batch, STEPS)
+
+    # --- bare-XLA baseline: same math, no framework ---
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((2, IMAGE, IMAGE, 3), jnp.bfloat16), train=True)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    opt_state = tx.init(params)
+
+    def loss_fn(p, bs, b):
+        logits, updates = model.apply(
+            {"params": p, "batch_stats": bs}, b["x"], train=True,
+            mutable=["batch_stats"],
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, b["label"][..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll), updates["batch_stats"]
+
+    @jax.jit
+    def bare_step(carry, b):
+        p, bs, os_ = carry
+        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, bs, b)
+        updates, new_os = tx.update(grads, os_, p)
+        new_p = optax.apply_updates(p, updates)
+        return (new_p, new_bs, new_os), {"loss": loss}
+
+    bare_state = (params, batch_stats, opt_state)
+    for _ in range(WARMUP):
+        bare_state, m = bare_step(bare_state, batch)
+    jax_block(m)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        bare_state, m = bare_step(bare_state, batch)
+    jax_block(m)
+    bare_sps = STEPS / (time.perf_counter() - t0)
+
+    images_per_sec = fw_sps * BATCH
+    print(json.dumps({
+        "metric": f"resnet50_train_images_per_sec_bf16_b{BATCH}",
+        "value": round(images_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(fw_sps / bare_sps, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

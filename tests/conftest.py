@@ -1,15 +1,22 @@
 """Test-session environment: force an 8-device virtual CPU platform.
 
-Must run before the first `import jax` anywhere in the test session so that
-multi-chip sharding tests (mesh/pjit/shard_map) exercise real 8-way SPMD
-partitioning without TPU hardware.  Mirrors the driver's dryrun_multichip
-environment (xla_force_host_platform_device_count).
+Runs before the first jax backend initialization so multi-chip sharding tests
+(mesh/pjit/shard_map) exercise real 8-way SPMD partitioning without TPU
+hardware — the same environment the driver uses for dryrun_multichip.
+
+Note: env vars alone are not enough here — the sandbox's sitecustomize
+registers the axon TPU PJRT plugin and prepends it to jax_platforms, so we
+override the config directly (allowed any time before backend init).
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

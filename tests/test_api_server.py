@@ -1,0 +1,140 @@
+"""REST API + RemoteCluster + CLI tests (the SDK-over-HTTP surface)."""
+import json
+import socket
+import sys
+import time
+
+import pytest
+
+from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.runtime.cluster import InMemoryCluster, NotFound
+from tf_operator_tpu.sdk.client import TPUJobClient
+from tf_operator_tpu.sdk.remote import RemoteCluster
+from tf_operator_tpu.server.api_server import start_api_server
+
+from testutil import new_tpujob
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.fixture
+def api_stack():
+    cluster = InMemoryCluster()
+    controller = TPUJobController(cluster, threadiness=2)
+    controller.start()
+    port = free_port()
+    server = start_api_server(cluster, port)
+    remote = RemoteCluster(f"http://127.0.0.1:{port}")
+    yield cluster, controller, remote
+    server.shutdown()
+    controller.stop()
+
+
+def wait_until(pred, timeout=10.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_create_get_list_delete_over_http(api_stack):
+    cluster, controller, remote = api_stack
+    client = TPUJobClient(remote)
+    job = new_tpujob(worker=2)
+    created = client.create(job)
+    assert created.metadata.uid
+
+    got = client.get("test-tpujob")
+    assert got.spec.replica_specs is not None
+    assert len(remote.list_jobs("default")) == 1
+
+    # controller acted on the HTTP-created job
+    assert wait_until(lambda: len(cluster.list_pods()) == 2)
+    pods = remote.list_pods("default", {"job-name": "test-tpujob"})
+    assert len(pods) == 2
+
+    client.delete("test-tpujob")
+    with pytest.raises(NotFound):
+        client.get("test-tpujob")
+
+
+def test_wait_for_job_over_http(api_stack):
+    cluster, controller, remote = api_stack
+    client = TPUJobClient(remote)
+    client.create(new_tpujob(worker=1))
+    assert wait_until(lambda: len(cluster.list_pods()) == 1)
+    pod = cluster.list_pods()[0]
+    cluster.set_pod_phase("default", pod.metadata.name, PodPhase.SUCCEEDED, exit_code=0)
+    client.wait_for_job("test-tpujob", timeout=15)
+    assert client.is_job_succeeded("test-tpujob")
+    events = client.get_events("test-tpujob")
+    assert any(e.reason == "TPUJobSucceeded" for e in events)
+
+
+def test_duplicate_create_conflict(api_stack):
+    from tf_operator_tpu.runtime.cluster import AlreadyExists
+
+    _, _, remote = api_stack
+    client = TPUJobClient(remote)
+    client.create(new_tpujob(worker=1))
+    with pytest.raises(AlreadyExists):
+        client.create(new_tpujob(worker=1))
+
+
+def test_bad_manifest_rejected(api_stack):
+    import urllib.request
+
+    _, _, remote = api_stack
+    req = urllib.request.Request(
+        f"{remote.base_url}/apis/v1/namespaces/default/tpujobs",
+        data=b'{"spec": {"replicaSpecs": {"Worker": {"restartPolicy": "Bogus"}}}}',
+        method="POST", headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req)
+    assert exc_info.value.code == 400
+
+
+def test_cli_flow(api_stack, tmp_path, capsys):
+    from tf_operator_tpu import cli
+
+    cluster, controller, remote = api_stack
+    manifest = tmp_path / "job.yaml"
+    manifest.write_text("""
+apiVersion: tpu-operator.dev/v1
+kind: TPUJob
+metadata:
+  name: cli-job
+spec:
+  replicaSpecs:
+    Worker:
+      replicas: 1
+      template:
+        spec:
+          containers:
+            - name: tensorflow
+              image: test:latest
+""")
+    base = ["--server", remote.base_url]
+    assert cli.main(base + ["apply", "-f", str(manifest)]) == 0
+    assert wait_until(lambda: len(cluster.list_pods()) == 1)
+
+    assert cli.main(base + ["get"]) == 0
+    out = capsys.readouterr().out
+    assert "cli-job" in out
+
+    cluster.set_pod_phase("default", "cli-job-worker-0", PodPhase.SUCCEEDED, exit_code=0)
+    assert cli.main(base + ["wait", "cli-job", "--timeout", "15"]) == 0
+    assert cli.main(base + ["events", "cli-job"]) == 0
+    out = capsys.readouterr().out
+    assert "TPUJobSucceeded" in out
+    assert cli.main(base + ["delete", "cli-job"]) == 0

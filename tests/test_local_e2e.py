@@ -1,0 +1,203 @@
+"""Hermetic E2E on the local process runtime: pods are real subprocesses.
+
+Mirrors the reference's E2E strategy (SURVEY.md §4): a controllable workload
+(workloads/test_server.py, the test_app.py analogue) verifies topology
+injection, restart semantics, and completion rules against actually-running
+processes; a real MNIST training job exercises the full path
+(simple_tfjob_tests.py analogue).
+"""
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from tf_operator_tpu.api.core import Container, ObjectMeta, PodTemplateSpec
+from tf_operator_tpu.api.types import (
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+)
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.runtime.local import LocalProcessCluster
+from tf_operator_tpu.sdk.client import TPUJobClient
+
+
+@pytest.fixture
+def local_stack(tmp_path):
+    repo_root = str(Path(__file__).resolve().parent.parent)
+    cluster = LocalProcessCluster(
+        workdir=str(tmp_path / "work"),
+        extra_env={"TPUJOB_FORCE_PLATFORM": "cpu", "PYTHONPATH": repo_root},
+    )
+    controller = TPUJobController(cluster, threadiness=2,
+                                  resolver=cluster.resolver)
+    controller.start()
+    client = TPUJobClient(cluster)
+    yield cluster, controller, client, tmp_path
+    controller.stop()
+    cluster.close()
+
+
+def make_test_server_job(name, ctrl_dir, replicas=2, restart_policy=RestartPolicy.NEVER,
+                     auto_exit_after=None, auto_exit_code=0):
+    args = ["--ctrl-dir", str(ctrl_dir)]
+    if auto_exit_after is not None:
+        args += ["--auto-exit-after", str(auto_exit_after),
+                 "--auto-exit-code", str(auto_exit_code)]
+    containers = [
+        Container(
+            name="tensorflow",
+            image="local",
+            command=[sys.executable, "-m", "tf_operator_tpu.workloads.test_server"],
+            args=args,
+        )
+    ]
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(replica_specs={
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=replicas,
+                restart_policy=restart_policy,
+                template=PodTemplateSpec(containers=containers),
+            )
+        }),
+    )
+
+
+def _patch_pod_name_env(cluster):
+    """Give each pod a POD_NAME env so the test-server writes per-pod files."""
+    orig = cluster._started_pod
+
+    def patched(pod):
+        c = pod.spec.containers[0]
+        c.set_env("POD_NAME", pod.metadata.name)
+        orig(pod)
+
+    cluster._started_pod = patched
+
+
+def wait_until(pred, timeout=30.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+class TestControllableWorkload:
+    def test_topology_injected_and_success(self, local_stack):
+        cluster, controller, client, tmp = local_stack
+        ctrl = tmp / "ctrl"
+        _patch_pod_name_env(cluster)
+        job = make_test_server_job("e2e-topo", ctrl, replicas=2)
+        client.create(job)
+
+        # both pods publish their env view (the /tfconfig analogue)
+        assert wait_until(
+            lambda: len(list(ctrl.glob("*.env.json"))) == 2, timeout=20
+        ), "test-server pods did not start"
+        view = json.loads((ctrl / "e2e-topo-worker-1.env.json").read_text())
+        tf_config = json.loads(view["TF_CONFIG"])
+        assert tf_config["task"] == {"type": "worker", "index": 1}
+        assert [a.startswith("127.0.0.1:") for a in tf_config["cluster"]["worker"]]
+        assert view["TPUJOB_NUM_PROCESSES"] == "2"
+
+        # command: everyone exit 0 → job Succeeded via all-workers rule
+        (ctrl / "all.cmd").write_text("exit 0")
+        result = client.wait_for_job("e2e-topo", timeout=30)
+        assert client.is_job_succeeded("e2e-topo")
+        logs = client.get_logs("e2e-topo")
+        assert any("exit 0" in text for text in logs.values())
+
+    def test_worker0_rule_with_straggler(self, local_stack):
+        cluster, controller, client, tmp = local_stack
+        ctrl = tmp / "ctrl"
+        _patch_pod_name_env(cluster)
+        job = make_test_server_job("e2e-w0", ctrl, replicas=2)
+        client.create(job)
+        assert wait_until(lambda: len(list(ctrl.glob("*.env.json"))) == 2, timeout=20)
+        # only worker-0 exits; default SuccessPolicy → job succeeds anyway
+        (ctrl / "e2e-w0-worker-0.cmd").write_text("exit 0")
+        client.wait_for_job("e2e-w0", timeout=30)
+        assert client.is_job_succeeded("e2e-w0")
+        # straggler reaped by CleanPodPolicy(Running)
+        assert wait_until(
+            lambda: all(
+                p.status.phase.value != "Running"
+                for p in cluster.list_pods(selector={"job-name": "e2e-w0"})
+            ),
+            timeout=20,
+        )
+
+    def test_exit_code_restart_real_process(self, local_stack):
+        cluster, controller, client, tmp = local_stack
+        ctrl = tmp / "ctrl"
+        _patch_pod_name_env(cluster)
+        job = make_test_server_job(
+            "e2e-restart", ctrl, replicas=1, restart_policy=RestartPolicy.EXIT_CODE
+        )
+        client.create(job)
+        assert wait_until(lambda: (ctrl / "e2e-restart-worker-0.env.json").exists(),
+                          timeout=20)
+        first_pid = cluster.get_pod("default", "e2e-restart-worker-0").metadata.annotations[
+            "local.tpu-operator.dev/pid"
+        ]
+        # die with retryable code 137 → controller deletes + recreates the pod
+        (ctrl / "e2e-restart-worker-0.cmd").write_text("exit 137")
+        assert wait_until(
+            lambda: (
+                (pods := cluster.list_pods(selector={"job-name": "e2e-restart"}))
+                and pods[0].metadata.annotations.get("local.tpu-operator.dev/pid")
+                not in (None, first_pid)
+            ),
+            timeout=30,
+        ), "pod was not restarted with a fresh process"
+        assert not client.is_job_succeeded("e2e-restart")
+        # now finish cleanly (overwrite command; new process sees new mtime)
+        time.sleep(0.2)
+        (ctrl / "e2e-restart-worker-0.cmd").write_text("exit 0")
+        client.wait_for_job("e2e-restart", timeout=30)
+        assert client.is_job_succeeded("e2e-restart")
+
+    def test_permanent_failure_fails_job(self, local_stack):
+        cluster, controller, client, tmp = local_stack
+        ctrl = tmp / "ctrl"
+        job = make_test_server_job(
+            "e2e-fail", ctrl, replicas=1,
+            restart_policy=RestartPolicy.EXIT_CODE,
+            auto_exit_after=0.3, auto_exit_code=1,
+        )
+        client.create(job)
+        result = client.wait_for_job("e2e-fail", timeout=30)
+        assert client.get_job_status("e2e-fail") == "Failed"
+
+
+@pytest.mark.slow
+def test_real_mnist_training_job(local_stack):
+    """Single-worker MNIST (BASELINE config 1): a real JAX training process
+    runs to completion under the controller."""
+    cluster, controller, client, tmp = local_stack
+    job = TPUJob(
+        metadata=ObjectMeta(name="mnist-single"),
+        spec=TPUJobSpec(replica_specs={
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=1,
+                template=PodTemplateSpec(containers=[Container(
+                    name="tensorflow", image="local",
+                    command=[sys.executable, "-m", "tf_operator_tpu.workloads.mnist"],
+                    args=["--steps", "30", "--target-loss", "1.0"],
+                )]),
+            )
+        }),
+    )
+    client.create(job)
+    client.wait_for_job("mnist-single", timeout=180)
+    logs = client.get_logs("mnist-single")
+    assert client.is_job_succeeded("mnist-single"), logs
+    assert any("final loss" in t for t in logs.values())

@@ -1,0 +1,138 @@
+"""Mesh / sharding / ring-attention tests (8 virtual CPU devices)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tf_operator_tpu.parallel.mesh import (
+    batch_sharding,
+    build_mesh,
+    local_batch_size,
+    param_partition_spec,
+)
+from tf_operator_tpu.parallel.ring_attention import (
+    reference_attention,
+    ring_attention,
+)
+from tf_operator_tpu.parallel.tp_rules import combined_spec, make_param_shardings
+
+
+class TestMesh:
+    def test_build(self):
+        mesh = build_mesh({"dp": 2, "tp": 4})
+        assert mesh.shape == {"dp": 2, "tp": 4}
+
+    def test_axis_order_canonical(self):
+        mesh = build_mesh({"tp": 2, "dp": 2, "sp": 2})
+        assert mesh.axis_names == ("dp", "tp", "sp")
+
+    def test_size_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            build_mesh({"dp": 3})
+
+    def test_default_all_dp(self):
+        mesh = build_mesh(None)
+        assert mesh.shape == {"dp": 8}
+
+    def test_env_mesh(self, monkeypatch):
+        from tf_operator_tpu.api import constants
+        from tf_operator_tpu.parallel.mesh import mesh_from_env
+
+        monkeypatch.setenv(constants.ENV_MESH_SHAPE, '{"dp": 4, "tp": 2}')
+        mesh = mesh_from_env()
+        assert mesh.shape == {"dp": 4, "tp": 2}
+
+    def test_local_batch(self):
+        mesh = build_mesh({"dp": 4, "tp": 2})
+        assert local_batch_size(32, mesh) == 8
+        with pytest.raises(ValueError):
+            local_batch_size(10, mesh)
+
+    def test_param_partition_spec_fsdp(self):
+        mesh = build_mesh({"fsdp": 8})
+        assert param_partition_spec((512, 128), mesh) == P(None, "fsdp")
+        assert param_partition_spec((7,), mesh) == P()
+
+
+class TestTPRules:
+    def test_megatron_pairing(self):
+        mesh = build_mesh({"dp": 2, "tp": 4})
+        # column-parallel qkv, row-parallel out (trailing Nones normalized off)
+        assert combined_spec("block_0/attn/query/kernel", (64, 8, 8), mesh) == P(None, "tp")
+        assert combined_spec("block_0/attn/out/kernel", (8, 8, 64), mesh) == P("tp")
+        assert combined_spec("block_0/mlp/wi/kernel", (64, 256), mesh) == P(None, "tp")
+        assert combined_spec("block_0/mlp/wo/kernel", (256, 64), mesh) == P("tp")
+        assert combined_spec("wte/embedding", (32000, 64), mesh) == P("tp")
+
+    def test_fsdp_fills_unsharded_dim(self):
+        mesh = build_mesh({"fsdp": 2, "tp": 4})
+        spec = combined_spec("block_0/mlp/wi/kernel", (64, 256), mesh)
+        assert spec == P("fsdp", "tp")
+
+    def test_no_tp_axis_no_tp_sharding(self):
+        mesh = build_mesh({"dp": 8})
+        assert combined_spec("block_0/mlp/wi/kernel", (64, 256), mesh) == P()
+
+    def test_make_param_shardings_tree(self):
+        mesh = build_mesh({"dp": 4, "tp": 2})
+        params = {"block_0": {"mlp": {"wi": {"kernel": jnp.zeros((16, 32))}}},
+                  "other": jnp.zeros((5,))}
+        sh = make_param_shardings(params, mesh)
+        assert sh["block_0"]["mlp"]["wi"]["kernel"].spec == P(None, "tp")
+        assert sh["other"].spec == P()
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("sp", [2, 4, 8])
+    def test_matches_reference(self, causal, sp):
+        mesh = build_mesh({"dp": 8 // sp, "sp": sp})
+        b, h, t, d = 2, 2, 64, 16
+        keys = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(keys[0], (b, h, t, d))
+        k = jax.random.normal(keys[1], (b, h, t, d))
+        v = jax.random.normal(keys[2], (b, h, t, d))
+        out = ring_attention(q, k, v, mesh, causal=causal)
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_bf16_inputs(self):
+        mesh = build_mesh({"sp": 8})
+        b, h, t, d = 1, 2, 64, 16
+        keys = jax.random.split(jax.random.PRNGKey(1), 3)
+        q = jax.random.normal(keys[0], (b, h, t, d), jnp.bfloat16)
+        k = jax.random.normal(keys[1], (b, h, t, d), jnp.bfloat16)
+        v = jax.random.normal(keys[2], (b, h, t, d), jnp.bfloat16)
+        out = ring_attention(q, k, v, mesh, causal=True)
+        assert out.dtype == jnp.bfloat16
+        ref = reference_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
+        )
+
+    def test_grad_flows(self):
+        mesh = build_mesh({"sp": 4, "dp": 2})
+        b, h, t, d = 2, 2, 32, 8
+        keys = jax.random.split(jax.random.PRNGKey(2), 3)
+        q = jax.random.normal(keys[0], (b, h, t, d))
+        k = jax.random.normal(keys[1], (b, h, t, d))
+        v = jax.random.normal(keys[2], (b, h, t, d))
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_ring, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=1e-4)
+
+
+def test_batch_sharding_places_batch_dim():
+    mesh = build_mesh({"dp": 4, "tp": 2})
+    x = jnp.zeros((8, 16))
+    placed = jax.device_put(x, batch_sharding(mesh))
+    assert placed.sharding.spec == P(("dp",))

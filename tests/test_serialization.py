@@ -1,0 +1,156 @@
+"""Manifest (de)serialization: native TPUJob + reference-TFJob ingestion."""
+import json
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.serialization import (
+    job_from_dict,
+    job_from_manifest,
+    job_to_dict,
+)
+from tf_operator_tpu.api.types import (
+    CleanPodPolicy,
+    ReplicaType,
+    RestartPolicy,
+)
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.validation import validate
+
+from testutil import new_tpujob
+
+REFERENCE_DIST_MNIST = """
+apiVersion: "kubeflow.org/v1"
+kind: "TFJob"
+metadata:
+  name: "dist-mnist-for-e2e-test"
+spec:
+  tfReplicaSpecs:
+    PS:
+      replicas: 2
+      restartPolicy: Never
+      template:
+        spec:
+          containers:
+            - name: tensorflow
+              image: kubeflow/tf-dist-mnist-test:1.0
+    Worker:
+      replicas: 4
+      restartPolicy: Never
+      template:
+        spec:
+          containers:
+            - name: tensorflow
+              image: kubeflow/tf-dist-mnist-test:1.0
+"""
+
+REFERENCE_GPU_JOB = """
+apiVersion: kubeflow.org/v1
+kind: TFJob
+metadata:
+  name: multi-worker
+spec:
+  cleanPodPolicy: None
+  tfReplicaSpecs:
+    Worker:
+      replicas: 2
+      restartPolicy: Never
+      template:
+        spec:
+          containers:
+            - name: tensorflow
+              image: kubeflowimages/multi_worker_strategy:v20200522
+              resources:
+                limits:
+                  nvidia.com/gpu: 1
+"""
+
+NATIVE_TPU_JOB = """
+apiVersion: tpu-operator.dev/v1
+kind: TPUJob
+metadata:
+  name: llm-pretrain
+spec:
+  enableDynamicWorker: false
+  runPolicy:
+    backoffLimit: 3
+    schedulingPolicy:
+      minAvailable: 4
+  replicaSpecs:
+    Worker:
+      replicas: 4
+      restartPolicy: ExitCode
+      tpu:
+        accelerator: v5litepod-8
+        topology: 2x4
+        mesh:
+          dp: 2
+          tp: 4
+      template:
+        spec:
+          containers:
+            - name: tpu
+              image: my-llm:latest
+"""
+
+
+def test_reference_dist_mnist_ingested():
+    """The reference's examples/v1 dist-mnist YAML loads unmodified."""
+    job = job_from_manifest(REFERENCE_DIST_MNIST)
+    assert job.metadata.name == "dist-mnist-for-e2e-test"
+    assert job.spec.replica_specs[ReplicaType.PS].replicas == 2
+    assert job.spec.replica_specs[ReplicaType.WORKER].replicas == 4
+    assert job.spec.replica_specs[ReplicaType.WORKER].restart_policy == RestartPolicy.NEVER
+    set_defaults(job)
+    validate(job)
+
+
+def test_reference_gpu_translated_to_tpu():
+    job = job_from_manifest(REFERENCE_GPU_JOB)
+    worker = job.spec.replica_specs[ReplicaType.WORKER]
+    resources = worker.template.containers[0].resources
+    assert constants.TPU_RESOURCE in resources
+    assert "nvidia.com/gpu" not in resources
+    # top-level cleanPodPolicy (v1 inline RunPolicy) honored
+    assert job.spec.run_policy.clean_pod_policy == CleanPodPolicy.NONE
+
+
+def test_native_manifest_with_tpu_block():
+    job = job_from_manifest(NATIVE_TPU_JOB)
+    worker = job.spec.replica_specs[ReplicaType.WORKER]
+    assert worker.restart_policy == RestartPolicy.EXIT_CODE
+    assert worker.tpu.topology == "2x4"
+    assert worker.tpu.mesh == {"dp": 2, "tp": 4}
+    assert job.spec.run_policy.scheduling_policy.min_available == 4
+    set_defaults(job)
+    validate(job)
+    assert worker.template.containers[0].resources[constants.TPU_RESOURCE] == 8.0
+
+
+def test_round_trip():
+    job = new_tpujob(worker=3, ps=1, chief=1)
+    job.spec.run_policy.backoff_limit = 2
+    data = job_to_dict(job)
+    back = job_from_dict(json.loads(json.dumps(data)))
+    assert back.metadata.name == job.metadata.name
+    assert set(back.spec.replica_specs) == set(job.spec.replica_specs)
+    assert back.spec.replica_specs[ReplicaType.WORKER].replicas == 3
+    assert back.spec.run_policy.backoff_limit == 2
+
+
+def test_status_round_trip():
+    from tf_operator_tpu.runtime import conditions
+    from tf_operator_tpu.api.types import JobConditionType
+
+    job = new_tpujob(worker=1)
+    conditions.update_job_conditions(job.status, JobConditionType.RUNNING, "r", "m")
+    back = job_from_dict(job_to_dict(job))
+    assert conditions.is_running(back.status)
+
+
+def test_mini_yaml_fallback():
+    from tf_operator_tpu.api.serialization import _mini_yaml
+
+    data = _mini_yaml(REFERENCE_DIST_MNIST)
+    assert data["kind"] == "TFJob"
+    assert data["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 4
+    containers = data["spec"]["tfReplicaSpecs"]["Worker"]["template"]["spec"]["containers"]
+    assert containers[0]["image"] == "kubeflow/tf-dist-mnist-test:1.0"

@@ -1,0 +1,145 @@
+"""tpujob CLI: kubectl-style verbs against the operator's REST API.
+
+Usage:
+  python -m tf_operator_tpu.cli apply -f job.yaml
+  python -m tf_operator_tpu.cli get [NAME] [-n NS] [-o json]
+  python -m tf_operator_tpu.cli wait NAME [--timeout 300]
+  python -m tf_operator_tpu.cli logs NAME [--replica-type worker]
+  python -m tf_operator_tpu.cli delete NAME
+  python -m tf_operator_tpu.cli events NAME
+
+The reference offers kubectl + its Python SDK for this surface
+(docs/quick-start-v1.md); this CLI folds both into the framework.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _client(args):
+    from .sdk.client import TPUJobClient
+    from .sdk.remote import RemoteCluster
+
+    cluster = RemoteCluster(args.server)
+    return TPUJobClient(cluster, namespace=args.namespace)
+
+
+def _format_age(ts):
+    if not ts:
+        return "-"
+    secs = int(time.time() - ts)
+    if secs < 120:
+        return f"{secs}s"
+    if secs < 7200:
+        return f"{secs // 60}m"
+    return f"{secs // 3600}h"
+
+
+def cmd_apply(args) -> int:
+    from .api.serialization import job_from_manifest
+
+    client = _client(args)
+    with (sys.stdin if args.filename == "-" else open(args.filename)) as f:
+        job = job_from_manifest(f.read())
+    created = client.create(job)
+    print(f"tpujob.{created.metadata.namespace}/{created.metadata.name} created")
+    return 0
+
+
+def cmd_get(args) -> int:
+    from .api.serialization import job_to_dict
+
+    client = _client(args)
+    if args.name:
+        jobs = [client.get(args.name)]
+    else:
+        jobs = client.cluster.list_jobs(args.namespace)
+    if args.output == "json":
+        payload = [job_to_dict(j) for j in jobs]
+        print(json.dumps(payload[0] if args.name else payload, indent=2))
+        return 0
+    print(f"{'NAME':30} {'STATE':12} {'AGE':6}")
+    for job in jobs:
+        state = ""
+        for cond in reversed(job.status.conditions):
+            if cond.status:
+                state = cond.type.value
+                break
+        print(f"{job.metadata.name:30} {state or 'Pending':12} "
+              f"{_format_age(job.metadata.creation_timestamp):6}")
+    return 0
+
+
+def cmd_wait(args) -> int:
+    client = _client(args)
+    job = client.wait_for_job(args.name, timeout=args.timeout)
+    state = client.get_job_status(args.name)
+    print(f"tpujob {args.name}: {state}")
+    return 0 if state == "Succeeded" else 1
+
+
+def cmd_logs(args) -> int:
+    client = _client(args)
+    logs = client.get_logs(args.name, replica_type=args.replica_type)
+    for pod, text in logs.items():
+        print(f"==> {pod} <==")
+        print(text)
+    return 0
+
+
+def cmd_delete(args) -> int:
+    client = _client(args)
+    client.delete(args.name)
+    print(f"tpujob {args.name} deleted")
+    return 0
+
+
+def cmd_events(args) -> int:
+    client = _client(args)
+    for event in client.get_events(args.name):
+        print(f"{event.event_type:8} {event.reason:24} {event.message}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("tpujob")
+    parser.add_argument("--server", default="http://127.0.0.1:8008")
+    parser.add_argument("-n", "--namespace", default="default")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("apply")
+    p.add_argument("-f", "--filename", required=True)
+    p.set_defaults(fn=cmd_apply)
+
+    p = sub.add_parser("get")
+    p.add_argument("name", nargs="?")
+    p.add_argument("-o", "--output", choices=("wide", "json"), default="wide")
+    p.set_defaults(fn=cmd_get)
+
+    p = sub.add_parser("wait")
+    p.add_argument("name")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.set_defaults(fn=cmd_wait)
+
+    p = sub.add_parser("logs")
+    p.add_argument("name")
+    p.add_argument("--replica-type", default=None)
+    p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("delete")
+    p.add_argument("name")
+    p.set_defaults(fn=cmd_delete)
+
+    p = sub.add_parser("events")
+    p.add_argument("name")
+    p.set_defaults(fn=cmd_events)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,52 @@
+"""MNIST models — the framework's smallest end-to-end workloads.
+
+Parity targets (BASELINE.json configs 1-2): the reference's
+examples/v1/mnist_with_summaries (single worker) and
+examples/v1/dist-mnist/dist_mnist.py:98-143 (2 PS + 4 workers).  The
+reference trains these in TF inside user containers; here they are JAX/flax
+models driven by workloads/mnist.py under the same TPUJob topology.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistMLP(nn.Module):
+    """The dist_mnist.py network: one 500-unit hidden layer
+    (ref: examples/v1/dist-mnist/dist_mnist.py:110-130)."""
+
+    hidden: int = 500
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class MnistCNN(nn.Module):
+    """The mnist_with_summaries-style convnet (two conv + two dense)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        if x.ndim == 2:
+            x = x.reshape((x.shape[0], 28, 28, 1))
+        elif x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(1024)(x)
+        x = nn.relu(x)
+        if train:
+            x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)

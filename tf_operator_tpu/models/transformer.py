@@ -1,0 +1,183 @@
+"""Transformer building blocks: causal LM (GPT-style) and BERT encoder.
+
+Parity target: BASELINE.json config 4 (BERT-base fine-tune TFJob with gang
+scheduling).  The LM variant is the long-context/distributed flagship: with a
+mesh carrying an `sp` axis it switches to ring attention
+(parallel/ring_attention.py) so sequence length scales across devices; with a
+`tp` axis, parameter sharding rules (parallel/tp_rules.py) partition the
+attention/MLP projections over the MXU fleet and XLA inserts the collectives.
+
+TPU choices: bf16 activations/matmuls with f32 params + f32 layernorm/softmax,
+fused attention kernel (ops/attention.py), optional per-block remat
+(jax.checkpoint) to trade FLOPs for HBM.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import flash_attention
+from ..parallel.ring_attention import ring_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_len: int = 2048
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    causal: bool = True
+    # Ring attention over this mesh axis when mesh is provided and the axis
+    # size > 1 (sequence sharded over ICI).
+    ring_axis: str = "sp"
+    mesh: Optional[Any] = None  # jax.sharding.Mesh (static/hashable)
+    remat: bool = False
+    # BERT extras
+    type_vocab_size: int = 2
+
+
+def _use_ring(cfg: TransformerConfig) -> bool:
+    return (
+        cfg.mesh is not None
+        and cfg.ring_axis in cfg.mesh.axis_names
+        and cfg.mesh.shape[cfg.ring_axis] > 1
+    )
+
+
+class SelfAttention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.cfg
+        head_dim = cfg.d_model // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, head_dim), dtype=cfg.dtype, name=name,
+            kernel_init=nn.initializers.normal(0.02),
+        )
+        q = dense("query")(x)
+        k = dense("key")(x)
+        v = dense("value")(x)
+        # [B, T, H, D] -> [B, H, T, D]
+        q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        if _use_ring(cfg):
+            out = ring_attention(
+                q, k, v, cfg.mesh, axis_name=cfg.ring_axis, causal=cfg.causal
+            )
+        else:
+            out = flash_attention(q, k, v, cfg.causal)
+        out = out.transpose(0, 2, 1, 3)  # [B, T, H, D]
+        return nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out",
+            kernel_init=nn.initializers.normal(0.02),
+        )(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="wi",
+                     kernel_init=nn.initializers.normal(0.02))(x)
+        h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype, name="wo",
+                        kernel_init=nn.initializers.normal(0.02))(h)
+
+
+class Block(nn.Module):
+    """Pre-norm transformer block."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)  # noqa: E731
+        x = x + SelfAttention(cfg, name="attn")(ln("ln1")(x).astype(cfg.dtype))
+        x = x + MLP(cfg, name="mlp")(ln("ln2")(x).astype(cfg.dtype))
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only causal language model."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens):
+        cfg = self.cfg
+        b, t = tokens.shape
+        emb = nn.Embed(cfg.vocab_size, cfg.d_model, name="wte",
+                       embedding_init=nn.initializers.normal(0.02))
+        pos_emb = self.param(
+            "wpe", nn.initializers.normal(0.02), (cfg.max_len, cfg.d_model)
+        )
+        x = emb(tokens) + pos_emb[None, :t, :]
+        x = x.astype(cfg.dtype)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, prevent_cse=False)
+        for i in range(cfg.num_layers):
+            x = block(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # Weight-tied readout keeps the big vocab matmul on the MXU in bf16.
+        logits = emb.attend(x.astype(cfg.dtype))
+        return logits.astype(jnp.float32)
+
+
+class BertEncoder(nn.Module):
+    """BERT-base-style bidirectional encoder with MLM + classification heads
+    (the reference's BERT fine-tune capability, BASELINE.json config 4)."""
+
+    cfg: TransformerConfig
+    num_labels: int = 2
+
+    @nn.compact
+    def __call__(self, tokens, token_types=None):
+        cfg = self.cfg
+        b, t = tokens.shape
+        if token_types is None:
+            token_types = jnp.zeros_like(tokens)
+        x = (
+            nn.Embed(cfg.vocab_size, cfg.d_model, name="tok_emb")(tokens)
+            + nn.Embed(cfg.type_vocab_size, cfg.d_model, name="type_emb")(token_types)
+            + self.param("pos_emb", nn.initializers.normal(0.02),
+                         (cfg.max_len, cfg.d_model))[None, :t, :]
+        )
+        x = nn.LayerNorm(dtype=jnp.float32, name="emb_ln")(x).astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        cls = jnp.tanh(nn.Dense(cfg.d_model, dtype=jnp.float32, name="pooler")(x[:, 0]))
+        return {
+            "sequence_output": x,
+            "logits": nn.Dense(self.num_labels, dtype=jnp.float32, name="classifier")(cls),
+        }
+
+
+def bert_base_config(**overrides) -> TransformerConfig:
+    base = dict(
+        vocab_size=30522, num_layers=12, num_heads=12, d_model=768,
+        d_ff=3072, max_len=512, causal=False,
+    )
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def gpt_small_config(**overrides) -> TransformerConfig:
+    base = dict(
+        vocab_size=32000, num_layers=12, num_heads=12, d_model=768,
+        d_ff=3072, max_len=2048, causal=True,
+    )
+    base.update(overrides)
+    return TransformerConfig(**base)

@@ -1,0 +1,173 @@
+"""Fused (flash) attention for TPU via Pallas, with a pure-XLA fallback.
+
+The reference operator ships no kernels (its math lives in user containers —
+SURVEY.md §2); this framework owns the compute path, so the hot op gets a
+TPU kernel: blockwise online-softmax attention (Flash-style) that keeps the
+O(T²) score matrix out of HBM, tiled to the MXU (128-aligned blocks, bf16
+inputs, f32 accumulation).
+
+Layout: q/k/v are [batch, heads, seq, head_dim]. The grid maps one program
+per (batch·head, q-block); K/V for that head stay resident in VMEM and are
+walked block-by-block with `lax.fori_loop` (static trip count — no dynamic
+shapes under jit).
+
+The backward pass currently recomputes through the XLA fallback (correct,
+O(T²) memory at grad time); a Pallas backward is a planned optimization.
+Sequence-parallel long-context attention lives in parallel/ring_attention.py
+and composes with this kernel per-shard.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU backend only
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
+                  block_q: int, block_k: int, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+    num_kb = seq_len // block_k
+
+    rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + rows
+            k_pos = kb * block_k + cols
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v_blk.astype(jnp.float32),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    if causal:
+        # Blocks strictly above the diagonal contribute nothing; bound the
+        # walk at the q-block's last row (static grid, traced bound is fine
+        # for fori_loop).
+        num_iters = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        num_iters = jnp.minimum(num_iters, num_kb)
+    else:
+        num_iters = num_kb
+    m, l, acc = lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
+    l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, scale: float, causal: bool,
+                   block_q: int, block_k: int, interpret: bool):
+    batch, heads, seq_len, head_dim = q.shape
+    bh = batch * heads
+    qf = q.reshape(bh, seq_len, head_dim)
+    kf = k.reshape(bh, seq_len, head_dim)
+    vf = v.reshape(bh, seq_len, head_dim)
+
+    block_q = min(block_q, seq_len)
+    block_k = min(block_k, seq_len)
+    if seq_len % block_q or seq_len % block_k:
+        raise ValueError(f"seq_len {seq_len} must be divisible by block sizes")
+
+    grid = (bh, seq_len // block_q)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=seq_len,
+    )
+    in_specs = [
+        pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
+    ]
+    out_spec = pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_spec,
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(batch, heads, seq_len, head_dim)
+
+
+def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None):
+    """Plain-XLA attention (fallback + backward recompute path)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        t_q, t_k = logits.shape[-2:]
+        rows = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
+        logits = jnp.where(rows >= cols, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v).astype(q.dtype)
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except RuntimeError:  # pragma: no cover
+        return False
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=True, scale=None, block_q=128, block_k=128):
+    """Fused attention; Pallas kernel on TPU, XLA fallback elsewhere."""
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    if _on_tpu():
+        return _flash_forward(q, k, v, s, causal, block_q, block_k, interpret=False)
+    return xla_attention(q, k, v, causal=causal, scale=s)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: xla_attention(q, k, v, causal=causal, scale=scale), q, k, v
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+def flash_attention_interpret(q, k, v, causal=True, scale=None,
+                              block_q=128, block_k=128):
+    """Interpreter-mode kernel execution (CPU correctness tests)."""
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash_forward(q, k, v, s, causal, block_q, block_k, interpret=True)

@@ -1,0 +1,123 @@
+"""Device-mesh construction and sharding helpers.
+
+This is the TPU-native half of the topology contract: the controller injects
+TPUJOB_MESH_SHAPE / TPUJOB_SLICE_TOPOLOGY (controller/topology.py, the
+re-imagined TF_CONFIG single injection point — ref
+/root/reference/pkg/controller.v1/tensorflow/pod.go:250-283), and this module
+turns it into a `jax.sharding.Mesh` the training runtime lays dp/fsdp/tp/sp/ep
+axes onto.  Within a slice the axes ride ICI; XLA inserts the collectives.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical axis names, outermost (slowest / DCN-friendly) first.
+AXIS_DP = "dp"      # data parallel (pure replication of params)
+AXIS_FSDP = "fsdp"  # data parallel with sharded params/optimizer state
+AXIS_TP = "tp"      # tensor (model) parallel
+AXIS_SP = "sp"      # sequence/context parallel (ring attention)
+AXIS_EP = "ep"      # expert parallel (MoE)
+AXIS_PP = "pp"      # pipeline parallel
+AXIS_ORDER = (AXIS_DP, AXIS_FSDP, AXIS_PP, AXIS_EP, AXIS_TP, AXIS_SP)
+
+
+def build_mesh(
+    axes: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh from {axis: size}.
+
+    Axis product must equal the device count; axes not mentioned are omitted.
+    With axes=None, all devices go on a single dp axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axes:
+        axes = {AXIS_DP: n}
+    # Keep canonical order for the axes given; unknown axes go last in
+    # insertion order (users may invent axes).
+    names = [a for a in AXIS_ORDER if a in axes] + [
+        a for a in axes if a not in AXIS_ORDER
+    ]
+    sizes = [int(axes[a]) for a in names]
+    total = int(np.prod(sizes)) if sizes else 1
+    if total != n:
+        raise ValueError(
+            f"mesh axes {dict(zip(names, sizes))} require {total} devices, "
+            f"but {n} are available"
+        )
+    device_array = np.asarray(devices).reshape(sizes)
+    return Mesh(device_array, axis_names=tuple(names))
+
+
+def mesh_from_env(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the mesh the controller assigned via TPUJOB_MESH_SHAPE."""
+    from ..api import constants
+
+    raw = os.environ.get(constants.ENV_MESH_SHAPE, "")
+    axes = json.loads(raw) if raw else None
+    return build_mesh(axes, devices)
+
+
+def data_axes(mesh: Mesh) -> tuple:
+    """The mesh axes a global batch is split over (dp + fsdp)."""
+    return tuple(a for a in (AXIS_DP, AXIS_FSDP) if a in mesh.axis_names)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over dp/fsdp, replicate the rest."""
+    axes = data_axes(mesh)
+    return NamedSharding(mesh, P(axes if axes else None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def axis_size(mesh: Mesh, name: str) -> int:
+    return mesh.shape[name] if name in mesh.axis_names else 1
+
+
+def param_partition_spec(
+    shape: Sequence[int], mesh: Mesh, fsdp_axis: str = AXIS_FSDP
+) -> P:
+    """FSDP-style weight sharding: shard the largest divisible dim over the
+    fsdp axis, replicate otherwise (the ZeRO-3 layout XLA turns into
+    all-gather-before-use / reduce-scatter-after-grad; cf. the
+    cross-replica weight-update sharding of arXiv:2004.13336)."""
+    size = axis_size(mesh, fsdp_axis)
+    if size <= 1 or not shape:
+        return P()
+    # Prefer the last divisible dim ≥ size (output features usually largest
+    # and contiguity-friendly), else the first divisible one.
+    candidates = [i for i, d in enumerate(shape) if d % size == 0 and d >= size]
+    if not candidates:
+        return P()
+    dim = candidates[-1]
+    spec = [None] * len(shape)
+    spec[dim] = fsdp_axis
+    return P(*spec)
+
+
+def shard_params(params, mesh: Mesh):
+    """Apply param_partition_spec across a pytree and device_put it."""
+    def place(x):
+        spec = param_partition_spec(getattr(x, "shape", ()), mesh)
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, params)
+
+
+def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    n = 1
+    for a in data_axes(mesh):
+        n *= axis_size(mesh, a)
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by dp size {n}")
+    return global_batch // n

@@ -1,0 +1,155 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context sequence/context parallelism is a first-class capability here
+(the reference schedules processes and leaves the math to user containers —
+SURVEY.md §2.9/§5 "long-context: absent; build the enabler + the kernels").
+
+Algorithm (Liu et al., "Ring Attention with Blockwise Transformers",
+arXiv:2310.01889): the sequence axis is sharded over the `sp` mesh axis; each
+device holds a query block and rotates K/V blocks around the ring with
+`ppermute` (one ICI hop per step), accumulating exact softmax attention
+online in log-sum-exp form.  Compute on each hop overlaps the next transfer;
+memory per device is O(T/N · T/N) instead of O(T²).
+
+Causal masking uses global block offsets derived from `lax.axis_index`, so
+fully-masked hops contribute zeros without data-dependent control flow
+(everything stays jit/scan friendly).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.8 (check_vma kwarg)
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_rep
+        )
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, bias, scale):
+    """One q-block × kv-block attention contribution.
+
+    q: [B, H, Tq, D], k/v: [B, H, Tk, D], bias: [Tq, Tk] additive mask.
+    Returns (numerator [B,H,Tq,D], row_max [B,H,Tq], row_sumexp [B,H,Tq]).
+    """
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale + bias[None, None, :, :]
+    m = jnp.max(logits, axis=-1)
+    p = jnp.exp(logits - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o, m, l
+
+
+def _combine(acc_o, acc_m, acc_l, o, m, l):
+    """Merge a new block into the online-softmax accumulator (log-sum-exp)."""
+    new_m = jnp.maximum(acc_m, m)
+    old_scale = jnp.exp(acc_m - new_m)
+    new_scale = jnp.exp(m - new_m)
+    new_l = acc_l * old_scale + l * new_scale
+    new_o = acc_o * old_scale[..., None] + o * new_scale[..., None]
+    return new_o, new_m, new_l
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
+    """Per-device body under shard_map: q/k/v are the local sequence shards
+    [B, H, T_local, D]."""
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    t_local = q.shape[2]
+
+    q32 = q.astype(jnp.float32)
+    acc_o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    acc_m = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+    acc_l = jnp.zeros(q.shape[:3], jnp.float32)
+
+    rows = lax.broadcasted_iota(jnp.int32, (t_local, t_local), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (t_local, t_local), 1)
+
+    def step(carry, step_idx):
+        acc_o, acc_m, acc_l, k_blk, v_blk = carry
+        # The block arriving at step s originated on device (my_idx - s) % n.
+        src_idx = (my_idx - step_idx) % n
+        if causal:
+            # Global positions: query row r lives at my_idx*T+r; key col c at
+            # src_idx*T+c.  Allowed iff q_pos >= k_pos.
+            q_pos = my_idx * t_local + rows
+            k_pos = src_idx * t_local + cols
+            bias = jnp.where(q_pos >= k_pos, 0.0, NEG_INF).astype(jnp.float32)
+        else:
+            bias = jnp.zeros((t_local, t_local), jnp.float32)
+        o, m, l = _block_attend(q32, k_blk, v_blk, bias, scale)
+        acc = _combine(acc_o, acc_m, acc_l, o, m, l)
+        # Rotate K/V one hop around the ring (device i -> i+1), so the next
+        # step sees the previous neighbor's block.
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (*acc, k_next, v_next), None
+
+    (acc_o, acc_m, acc_l, _, _), _ = lax.scan(
+        step, (acc_o, acc_m, acc_l, k, v), jnp.arange(n)
+    )
+    # Guard fully-masked rows (can only happen with exotic masks): avoid 0/0.
+    denom = jnp.where(acc_l == 0.0, 1.0, acc_l)
+    return (acc_o / denom[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention with the sequence axis sharded over `axis_name`.
+
+    Inputs are global arrays [B, H, T, D] (sharded or to-be-sharded on T);
+    output matches q's shape/dtype.  T must divide evenly by the sp axis size.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+        ),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )
+    return fn(q, k, v)
+
+
+def reference_attention(q, k, v, *, causal=True, scale=None):
+    """Single-device exact attention, the correctness oracle for tests."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        t_q, t_k = logits.shape[-2:]
+        rows = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
+        logits = jnp.where(rows >= cols, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v).astype(q.dtype)

@@ -1,0 +1,105 @@
+"""Parameter-sharding rules: tensor parallelism + FSDP via GSPMD annotations.
+
+Instead of translating NCCL/megatron-style explicit collectives, parallelism
+here is declared: each parameter gets a PartitionSpec over the mesh
+('tp' for model-parallel dims, 'fsdp' for ZeRO-style sharding of what's
+left), and XLA's SPMD partitioner inserts the all-gathers/reduce-scatters
+over ICI (scaling-book recipe: pick a mesh, annotate, let XLA place
+collectives).
+
+Rules follow the Megatron pairing so no extra communication appears inside a
+block: column-parallel qkv/wi (output-dim sharded) feed row-parallel out/wo
+(input-dim sharded), yielding one psum per attention/MLP pair.
+"""
+from __future__ import annotations
+
+import re
+from typing import Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import AXIS_FSDP, AXIS_TP, axis_size
+
+# (path regex, spec builder taking ndim) — first match wins.  Paths are
+# '/'-joined flax param paths, e.g. "block_3/attn/query/kernel".
+_TP_RULES: Tuple[Tuple[str, dict], ...] = (
+    # attention projections: DenseGeneral kernels (d_model, heads, head_dim)
+    (r"attn/(query|key|value)/kernel$", {"shard_dim": 1}),
+    (r"attn/(query|key|value)/bias$", {"shard_dim": 0}),
+    # out projection kernel (heads, head_dim, d_model): shard input heads
+    (r"attn/out/kernel$", {"shard_dim": 0}),
+    (r"attn/out/bias$", {"shard_dim": None}),
+    # MLP: wi column-parallel, wo row-parallel
+    (r"mlp/wi/kernel$", {"shard_dim": 1}),
+    (r"mlp/wi/bias$", {"shard_dim": 0}),
+    (r"mlp/wo/kernel$", {"shard_dim": 0}),
+    (r"mlp/wo/bias$", {"shard_dim": None}),
+    # embeddings: vocab-sharded
+    (r"(wte|tok_emb)/embedding$", {"shard_dim": 0}),
+)
+
+
+def tp_spec_for_path(path: str, ndim: int, mesh: Mesh) -> Optional[P]:
+    """The tensor-parallel PartitionSpec for a param path, or None if no
+    rule matches / tp axis absent."""
+    if axis_size(mesh, AXIS_TP) <= 1:
+        return None
+    for pattern, rule in _TP_RULES:
+        if re.search(pattern, path):
+            dim = rule["shard_dim"]
+            if dim is None or dim >= ndim:
+                return P()
+            spec = [None] * ndim
+            spec[dim] = AXIS_TP
+            return P(*spec)
+    return None
+
+
+def combined_spec(path: str, shape, mesh: Mesh) -> P:
+    """TP rule first; then FSDP-shard the largest remaining divisible dim."""
+    ndim = len(shape)
+    spec = tp_spec_for_path(path, ndim, mesh)
+    parts = list(spec) if spec is not None else [None] * ndim
+    while len(parts) < ndim:
+        parts.append(None)
+    fsdp = axis_size(mesh, AXIS_FSDP)
+    if fsdp > 1:
+        candidates = [
+            i for i, d in enumerate(shape)
+            if parts[i] is None and d % fsdp == 0 and d >= fsdp
+        ]
+        if candidates:
+            # Largest dim gives the most memory savings.
+            dim = max(candidates, key=lambda i: shape[i])
+            parts[dim] = AXIS_FSDP
+    while parts and parts[-1] is None:
+        parts.pop()
+    return P(*parts)
+
+
+def _flatten_paths(tree):
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for key_path, leaf in flat:
+        path = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
+        )
+        yield path, leaf
+
+
+def make_param_shardings(params, mesh: Mesh):
+    """Pytree of NamedShardings matching `params` (tp + fsdp rules)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    shardings = []
+    for key_path, leaf in flat:
+        path = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
+        )
+        spec = combined_spec(path, getattr(leaf, "shape", ()), mesh)
+        shardings.append(NamedSharding(mesh, spec))
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def shard_params(params, mesh: Mesh):
+    """device_put params according to the combined tp+fsdp rules."""
+    return jax.device_put(params, make_param_shardings(params, mesh))

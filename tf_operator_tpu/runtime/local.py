@@ -1,0 +1,158 @@
+"""LocalProcessCluster: pods are real OS processes.
+
+The reference delegates pod execution to kubelet and tests multi-node
+behavior with a controllable in-container flask app (SURVEY.md §4 Tier 3).
+This backend collapses that stack for single-host use: `create_pod` launches
+the pod's container command as a subprocess with the controller-injected env
+(TF_CONFIG + TPUJOB_*), a monitor thread turns process exits into pod phase
+transitions (exit 0 → Succeeded, else Failed with the exit code), and logs
+are captured per pod for `TPUJobClient.get_logs` parity
+(ref: sdk tf_job_client.py get_logs, :340-356).
+
+Replica addresses resolve to 127.0.0.1 with a deterministic per-replica port
+(the headless-DNS analogue: stable identity across restarts —
+ref service naming, vendor/.../common/service.go:303-317).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+import time
+from pathlib import Path
+from typing import Dict, Optional, Tuple
+
+from ..api import constants
+from ..api.core import ContainerStatus, Pod, PodPhase
+from ..api.types import ReplicaType, TPUJob
+from ..utils import logging as tpulog
+from .cluster import EventType, InMemoryCluster
+
+log = tpulog.logger_for_key("local-cluster")
+
+
+class LocalProcessCluster(InMemoryCluster):
+    def __init__(self, workdir: Optional[str] = None, base_port: int = 20000,
+                 extra_env: Optional[Dict[str, str]] = None) -> None:
+        super().__init__()
+        self.workdir = Path(workdir or ".tpujob-local")
+        self.workdir.mkdir(parents=True, exist_ok=True)
+        self.base_port = base_port
+        self.extra_env = dict(extra_env or {})
+        self._procs: Dict[Tuple[str, str], subprocess.Popen] = {}
+        self._ports: Dict[str, int] = {}
+        self._port_lock = threading.Lock()
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._monitor_started = False
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    # address resolution (plugs into TPUJobController(resolver=...))
+
+    def resolver(self, job: TPUJob, rtype: ReplicaType, index: int, port: int) -> str:
+        return f"127.0.0.1:{self.port_for(job.metadata.name, rtype.value, index)}"
+
+    def port_for(self, job_name: str, rtype: str, index: int) -> int:
+        key = f"{job_name}/{rtype.lower()}/{index}"
+        with self._port_lock:
+            if key not in self._ports:
+                self._ports[key] = self.base_port + len(self._ports)
+            return self._ports[key]
+
+    # ------------------------------------------------------------------
+    # pod lifecycle hooks
+
+    def _started_pod(self, pod: Pod) -> None:
+        if not self._monitor_started:
+            self._monitor_started = True
+            self._monitor.start()
+        container = pod.spec.container(
+            constants.DEFAULT_CONTAINER_NAME, constants.ALT_CONTAINER_NAME
+        )
+        if container is None or not (container.command or container.args):
+            return  # nothing to run; stays Pending (image-only template)
+        argv = list(container.command) + list(container.args)
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        for e in container.env:
+            env[e.name] = e.value
+        env.setdefault("PYTHONUNBUFFERED", "1")
+        log_path = self.workdir / f"{pod.metadata.namespace}-{pod.metadata.name}.log"
+        try:
+            logf = open(log_path, "ab")
+            proc = subprocess.Popen(
+                argv, env=env, stdout=logf, stderr=subprocess.STDOUT,
+                cwd=str(self.workdir), start_new_session=True,
+            )
+        except OSError as err:
+            log.warning("failed to start pod %s: %s", pod.metadata.name, err)
+            self._transition(pod, PodPhase.FAILED, exit_code=127)
+            return
+        self._procs[(pod.metadata.namespace, pod.metadata.name)] = proc
+        pod.metadata.annotations["local.tpu-operator.dev/pid"] = str(proc.pid)
+        pod.metadata.annotations["local.tpu-operator.dev/log"] = str(log_path)
+        self._transition(pod, PodPhase.RUNNING)
+
+    def _stopped_pod(self, pod: Pod) -> None:
+        proc = self._procs.pop((pod.metadata.namespace, pod.metadata.name), None)
+        if proc is not None and proc.poll() is None:
+            try:
+                # SIGTERM to the process group, kubelet-style grace.
+                os.killpg(proc.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def _transition(self, pod: Pod, phase: PodPhase, exit_code: Optional[int] = None) -> None:
+        pod.status.phase = phase
+        if pod.status.start_time is None and phase != PodPhase.PENDING:
+            pod.status.start_time = time.time()
+        cname = pod.spec.containers[0].name if pod.spec.containers else "tensorflow"
+        if not pod.status.container_statuses:
+            pod.status.container_statuses = [ContainerStatus(name=cname)]
+        cs = pod.status.container_statuses[0]
+        cs.running = phase == PodPhase.RUNNING
+        if exit_code is not None:
+            cs.terminated = True
+            cs.exit_code = exit_code
+        self._dispatch(self._pod_handlers, EventType.MODIFIED, pod)
+
+    def _monitor_loop(self) -> None:
+        while not self._closed:
+            for key, proc in list(self._procs.items()):
+                rc = proc.poll()
+                if rc is None:
+                    continue
+                self._procs.pop(key, None)
+                try:
+                    pod = self.get_pod(*key)
+                except KeyError:
+                    continue
+                # Negative returncode = killed by signal N; containers report
+                # 128+N (the convention the exit-code classifier expects,
+                # ref train_util.go:18-53).
+                exit_code = 128 - rc if rc < 0 else rc
+                phase = PodPhase.SUCCEEDED if exit_code == 0 else PodPhase.FAILED
+                log.info("pod %s exited rc=%s -> %s", key[1], exit_code, phase.value)
+                self._transition(pod, phase, exit_code=exit_code)
+            time.sleep(0.05)
+
+    # ------------------------------------------------------------------
+
+    def pod_logs(self, namespace: str, name: str) -> str:
+        pod = self.get_pod(namespace, name)
+        path = pod.metadata.annotations.get("local.tpu-operator.dev/log")
+        if not path or not os.path.exists(path):
+            return ""
+        with open(path, "rb") as f:
+            return f.read().decode(errors="replace")
+
+    def close(self) -> None:
+        self._closed = True
+        for proc in list(self._procs.values()):
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        self._procs.clear()

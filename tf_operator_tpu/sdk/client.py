@@ -1,0 +1,136 @@
+"""TPUJobClient — the user-facing submit/watch/logs API.
+
+API-parity rebuild of the reference's Python SDK
+(/root/reference/sdk/python/kubeflow/tfjob/api/tf_job_client.py:52-356):
+create, get, patch, delete, wait_for_job, wait_for_condition, get_job_status,
+is_job_running, is_job_succeeded, get_pod_names, get_logs — against a
+ClusterInterface instead of the k8s CustomObjects REST API.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Iterable, List, Optional
+
+from ..api import constants
+from ..api.types import JobConditionType, TPUJob
+from ..runtime import conditions
+from ..runtime.cluster import ClusterInterface
+
+TERMINAL_CONDITIONS = ("Succeeded", "Failed")
+
+
+class TimeoutError_(TimeoutError):
+    pass
+
+
+class TPUJobClient:
+    def __init__(self, cluster: ClusterInterface, namespace: str = "default") -> None:
+        self.cluster = cluster
+        self.namespace = namespace
+
+    # --- CRUD (ref: tf_job_client.py:52-197) ---
+
+    def create(self, job: TPUJob, namespace: Optional[str] = None) -> TPUJob:
+        if namespace:
+            job.metadata.namespace = namespace
+        elif not job.metadata.namespace:
+            job.metadata.namespace = self.namespace
+        return self.cluster.create_job(job)
+
+    def get(self, name: str, namespace: Optional[str] = None) -> TPUJob:
+        return self.cluster.get_job(namespace or self.namespace, name)
+
+    def patch(self, name: str, patch_fn: Callable[[TPUJob], None],
+              namespace: Optional[str] = None) -> TPUJob:
+        job = self.get(name, namespace)
+        patch_fn(job)
+        return self.cluster.update_job(job)
+
+    def delete(self, name: str, namespace: Optional[str] = None) -> None:
+        self.cluster.delete_job(namespace or self.namespace, name)
+
+    # --- status helpers (ref: tf_job_client.py:283-340) ---
+
+    def get_job_status(self, name: str, namespace: Optional[str] = None) -> str:
+        job = self.get(name, namespace)
+        if job.status.conditions:
+            # latest condition with status true wins
+            for cond in reversed(job.status.conditions):
+                if cond.status:
+                    return cond.type.value
+        return ""
+
+    def is_job_running(self, name: str, namespace: Optional[str] = None) -> bool:
+        return self.get_job_status(name, namespace) == "Running"
+
+    def is_job_succeeded(self, name: str, namespace: Optional[str] = None) -> bool:
+        return self.get_job_status(name, namespace) == "Succeeded"
+
+    # --- waiting (ref: wait_for_condition :234-281, wait_for_job :198-233) ---
+
+    def wait_for_condition(
+        self,
+        name: str,
+        expected: Iterable[str],
+        namespace: Optional[str] = None,
+        timeout: float = 120.0,
+        polling_interval: float = 0.1,
+        status_callback: Optional[Callable[[TPUJob], None]] = None,
+    ) -> TPUJob:
+        expected = set(expected)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            job = self.get(name, namespace)
+            if status_callback is not None:
+                status_callback(job)
+            for cond in job.status.conditions:
+                if cond.status and cond.type.value in expected:
+                    return job
+            time.sleep(polling_interval)
+        raise TimeoutError_(
+            f"timeout waiting for TPUJob {name} to reach {sorted(expected)}; "
+            f"currently {self.get_job_status(name, namespace)!r}"
+        )
+
+    def wait_for_job(self, name: str, namespace: Optional[str] = None,
+                     timeout: float = 120.0) -> TPUJob:
+        job = self.wait_for_condition(name, TERMINAL_CONDITIONS, namespace, timeout)
+        return job
+
+    def wait_for_deletion(self, name: str, namespace: Optional[str] = None,
+                          timeout: float = 60.0) -> None:
+        from ..runtime.cluster import NotFound
+
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                self.get(name, namespace)
+            except NotFound:
+                return
+            time.sleep(0.1)
+        raise TimeoutError_(f"timeout waiting for TPUJob {name} deletion")
+
+    # --- pods / logs (ref: get_pod_names :341-364, get_logs :340-356) ---
+
+    def get_pod_names(self, name: str, namespace: Optional[str] = None,
+                      replica_type: Optional[str] = None) -> List[str]:
+        ns = namespace or self.namespace
+        selector = {
+            constants.LABEL_GROUP_NAME: constants.API_GROUP,
+            constants.LABEL_JOB_NAME: name,
+        }
+        if replica_type:
+            selector[constants.LABEL_REPLICA_TYPE] = replica_type.lower()
+        return sorted(p.metadata.name for p in self.cluster.list_pods(ns, selector))
+
+    def get_logs(self, name: str, namespace: Optional[str] = None,
+                 replica_type: Optional[str] = None) -> dict:
+        ns = namespace or self.namespace
+        logs = {}
+        for pod_name in self.get_pod_names(name, ns, replica_type):
+            getter = getattr(self.cluster, "pod_logs", None)
+            logs[pod_name] = getter(ns, pod_name) if getter else ""
+        return logs
+
+    def get_events(self, name: str, namespace: Optional[str] = None) -> list:
+        return self.cluster.list_events(namespace or self.namespace, name)

@@ -1,0 +1,156 @@
+"""RemoteCluster: ClusterInterface over the operator's REST API.
+
+The out-of-process half of the SDK: TPUJobClient(RemoteCluster(url)) gives
+the same create/wait/logs surface as the reference SDK has against the k8s
+apiserver (ref: sdk/python/kubeflow/tfjob/api/tf_job_client.py).  Only the
+read/write verbs a client needs are implemented; watches are client-side
+polling (wait_for_condition), matching the reference SDK's get/poll loop.
+"""
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from ..api.core import ContainerStatus, ObjectMeta, Pod, PodPhase, PodStatus
+from ..api.serialization import job_from_dict, job_to_dict
+from ..api.types import TPUJob
+from ..runtime.cluster import AlreadyExists, ClusterInterface, NotFound
+
+
+class ApiError(RuntimeError):
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(f"HTTP {code}: {message}")
+        self.code = code
+
+
+class RemoteCluster(ClusterInterface):
+    def __init__(self, base_url: str = "http://127.0.0.1:8008", timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    # ------------------------------------------------------------------
+    def _request(self, method: str, path: str, payload: Optional[dict] = None) -> dict:
+        url = f"{self.base_url}{path}"
+        data = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as err:
+            body = err.read().decode(errors="replace")
+            try:
+                message = json.loads(body).get("error", body)
+            except json.JSONDecodeError:
+                message = body
+            if err.code == 404:
+                raise NotFound(message) from None
+            if err.code == 409:
+                raise AlreadyExists(message) from None
+            raise ApiError(err.code, message) from None
+
+    # --- jobs ---
+
+    def create_job(self, job: TPUJob) -> TPUJob:
+        ns = job.metadata.namespace or "default"
+        data = self._request("POST", f"/apis/v1/namespaces/{ns}/tpujobs",
+                             job_to_dict(job))
+        return job_from_dict(data)
+
+    def get_job(self, namespace: str, name: str) -> TPUJob:
+        return job_from_dict(
+            self._request("GET", f"/apis/v1/namespaces/{namespace}/tpujobs/{name}")
+        )
+
+    def list_jobs(self, namespace: Optional[str] = None) -> List[TPUJob]:
+        ns = namespace or "default"
+        data = self._request("GET", f"/apis/v1/namespaces/{ns}/tpujobs")
+        return [job_from_dict(item) for item in data.get("items", [])]
+
+    def update_job(self, job: TPUJob) -> TPUJob:
+        ns = job.metadata.namespace
+        data = self._request(
+            "PUT", f"/apis/v1/namespaces/{ns}/tpujobs/{job.metadata.name}",
+            job_to_dict(job),
+        )
+        return job_from_dict(data)
+
+    def delete_job(self, namespace: str, name: str) -> None:
+        self._request("DELETE", f"/apis/v1/namespaces/{namespace}/tpujobs/{name}")
+
+    # --- pods (read-only client view) ---
+
+    def list_pods(self, namespace: Optional[str] = None,
+                  selector: Optional[Dict[str, str]] = None) -> List[Pod]:
+        ns = namespace or "default"
+        path = f"/apis/v1/namespaces/{ns}/pods"
+        if selector:
+            sel = ",".join(f"{k}={v}" for k, v in selector.items())
+            path += f"?selector={sel}"
+        data = self._request("GET", path)
+        return [self._pod_from_dict(item) for item in data.get("items", [])]
+
+    @staticmethod
+    def _pod_from_dict(data: dict) -> Pod:
+        meta = data.get("metadata", {})
+        status = data.get("status", {})
+        return Pod(
+            metadata=ObjectMeta(
+                name=meta.get("name", ""),
+                namespace=meta.get("namespace", "default"),
+                labels=dict(meta.get("labels") or {}),
+                annotations=dict(meta.get("annotations") or {}),
+            ),
+            status=PodStatus(
+                phase=PodPhase(status.get("phase", "Pending")),
+                start_time=status.get("startTime"),
+                container_statuses=[
+                    ContainerStatus(
+                        name=cs.get("name", ""),
+                        restart_count=int(cs.get("restartCount", 0)),
+                        running=bool(cs.get("running")),
+                        terminated=bool(cs.get("terminated")),
+                        exit_code=cs.get("exitCode"),
+                    )
+                    for cs in status.get("containerStatuses") or []
+                ],
+            ),
+        )
+
+    def pod_logs(self, namespace: str, name: str) -> str:
+        data = self._request(
+            "GET", f"/apis/v1/namespaces/{namespace}/pods/{name}/log"
+        )
+        return data.get("log", "")
+
+    # --- events ---
+
+    def list_events(self, namespace: Optional[str] = None,
+                    object_name: Optional[str] = None) -> list:
+        from ..api.core import Event
+
+        ns = namespace or "default"
+        path = f"/apis/v1/namespaces/{ns}/events"
+        if object_name:
+            path += f"?object={object_name}"
+        data = self._request("GET", path)
+        return [
+            Event(
+                object_kind="TPUJob",
+                object_name=item.get("object", ""),
+                namespace=ns,
+                event_type=item.get("type", ""),
+                reason=item.get("reason", ""),
+                message=item.get("message", ""),
+                timestamp=item.get("timestamp", 0.0),
+            )
+            for item in data.get("items", [])
+        ]
+
+    def healthz(self) -> bool:
+        try:
+            return self._request("GET", "/healthz").get("status") == "ok"
+        except (OSError, ApiError):
+            return False

@@ -1,0 +1,197 @@
+"""REST API: the apiserver-shaped surface of the operator.
+
+The reference's SDK talks to the Kubernetes CustomObjects REST API
+(/root/reference/sdk/python/kubeflow/tfjob/api/tf_job_client.py) and its E2E
+suite reaches pods through the apiserver proxy.  This module provides the
+equivalent HTTP surface for the local runtime so out-of-process clients
+(sdk.remote.RemoteCluster, the tpujob CLI) can submit and watch jobs:
+
+  POST   /apis/v1/namespaces/{ns}/tpujobs            create (JSON manifest)
+  GET    /apis/v1/namespaces/{ns}/tpujobs            list
+  GET    /apis/v1/namespaces/{ns}/tpujobs/{name}     get
+  PUT    /apis/v1/namespaces/{ns}/tpujobs/{name}     replace spec
+  DELETE /apis/v1/namespaces/{ns}/tpujobs/{name}     delete
+  GET    /apis/v1/namespaces/{ns}/pods[?selector=k=v,...]
+  GET    /apis/v1/namespaces/{ns}/pods/{name}/log
+  GET    /apis/v1/namespaces/{ns}/events[?object=name]
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ..api.serialization import job_from_dict, job_to_dict
+from ..runtime.cluster import AlreadyExists, ClusterInterface, NotFound
+
+_JOB_RE = re.compile(r"^/apis/v1/namespaces/([^/]+)/tpujobs(?:/([^/]+))?$")
+_POD_RE = re.compile(r"^/apis/v1/namespaces/([^/]+)/pods(?:/([^/]+)(/log)?)?$")
+_EVENT_RE = re.compile(r"^/apis/v1/namespaces/([^/]+)/events$")
+
+
+def _pod_to_dict(pod) -> dict:
+    return {
+        "metadata": {
+            "name": pod.metadata.name,
+            "namespace": pod.metadata.namespace,
+            "labels": dict(pod.metadata.labels),
+            "annotations": dict(pod.metadata.annotations),
+        },
+        "status": {
+            "phase": pod.status.phase.value,
+            "startTime": pod.status.start_time,
+            "containerStatuses": [
+                {
+                    "name": cs.name,
+                    "restartCount": cs.restart_count,
+                    "running": cs.running,
+                    "terminated": cs.terminated,
+                    "exitCode": cs.exit_code,
+                }
+                for cs in pod.status.container_statuses
+            ],
+        },
+    }
+
+
+def make_handler(cluster: ClusterInterface):
+    class ApiHandler(BaseHTTPRequestHandler):
+        server_version = "tpu-operator-api"
+
+        # ------------------------------------------------------------------
+        def _send(self, code: int, payload) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_error(self, code: int, message: str) -> None:
+            self._send(code, {"error": message})
+
+        def _body(self) -> dict:
+            length = int(self.headers.get("Content-Length", 0))
+            return json.loads(self.rfile.read(length) or b"{}")
+
+        # ------------------------------------------------------------------
+        def do_GET(self):  # noqa: N802
+            parsed = urlparse(self.path)
+            query = parse_qs(parsed.query)
+
+            m = _JOB_RE.match(parsed.path)
+            if m:
+                ns, name = m.group(1), m.group(2)
+                try:
+                    if name:
+                        self._send(200, job_to_dict(cluster.get_job(ns, name)))
+                    else:
+                        self._send(200, {
+                            "items": [job_to_dict(j) for j in cluster.list_jobs(ns)]
+                        })
+                except NotFound as err:
+                    self._send_error(404, str(err))
+                return
+
+            m = _POD_RE.match(parsed.path)
+            if m:
+                ns, name, want_log = m.group(1), m.group(2), m.group(3)
+                try:
+                    if name and want_log:
+                        getter = getattr(cluster, "pod_logs", None)
+                        text = getter(ns, name) if getter else ""
+                        self._send(200, {"log": text})
+                    elif name:
+                        self._send(200, _pod_to_dict(cluster.get_pod(ns, name)))
+                    else:
+                        selector = None
+                        if "selector" in query:
+                            selector = dict(
+                                part.split("=", 1)
+                                for part in query["selector"][0].split(",")
+                                if "=" in part
+                            )
+                        pods = cluster.list_pods(ns, selector)
+                        self._send(200, {"items": [_pod_to_dict(p) for p in pods]})
+                except NotFound as err:
+                    self._send_error(404, str(err))
+                return
+
+            m = _EVENT_RE.match(parsed.path)
+            if m:
+                ns = m.group(1)
+                obj = query.get("object", [None])[0]
+                events = cluster.list_events(ns, obj)
+                self._send(200, {"items": [
+                    {"type": e.event_type, "reason": e.reason, "message": e.message,
+                     "object": e.object_name, "timestamp": e.timestamp}
+                    for e in events
+                ]})
+                return
+
+            if parsed.path == "/healthz":
+                self._send(200, {"status": "ok"})
+                return
+            self._send_error(404, f"unknown path {parsed.path}")
+
+        def do_POST(self):  # noqa: N802
+            m = _JOB_RE.match(urlparse(self.path).path)
+            if not (m and not m.group(2)):
+                self._send_error(404, "POST only supported on the tpujobs collection")
+                return
+            ns = m.group(1)
+            try:
+                job = job_from_dict(self._body())
+            except (ValueError, KeyError) as err:
+                self._send_error(400, f"bad manifest: {err}")
+                return
+            job.metadata.namespace = ns
+            try:
+                created = cluster.create_job(job)
+            except AlreadyExists as err:
+                self._send_error(409, str(err))
+                return
+            self._send(201, job_to_dict(created))
+
+        def do_PUT(self):  # noqa: N802
+            m = _JOB_RE.match(urlparse(self.path).path)
+            if not (m and m.group(2)):
+                self._send_error(404, "PUT requires a job name")
+                return
+            ns, name = m.group(1), m.group(2)
+            try:
+                current = cluster.get_job(ns, name)
+                incoming = job_from_dict(self._body())
+                current.spec = incoming.spec
+                updated = cluster.update_job(current)
+                self._send(200, job_to_dict(updated))
+            except NotFound as err:
+                self._send_error(404, str(err))
+
+        def do_DELETE(self):  # noqa: N802
+            m = _JOB_RE.match(urlparse(self.path).path)
+            if not (m and m.group(2)):
+                self._send_error(404, "DELETE requires a job name")
+                return
+            try:
+                cluster.delete_job(m.group(1), m.group(2))
+                self._send(200, {"status": "deleted"})
+            except NotFound as err:
+                self._send_error(404, str(err))
+
+        def log_message(self, fmt, *args):
+            pass
+
+    return ApiHandler
+
+
+def start_api_server(cluster: ClusterInterface, port: int,
+                     host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    server = ThreadingHTTPServer((host, port), make_handler(cluster))
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="api-server")
+    thread.start()
+    return server

@@ -1,0 +1,215 @@
+"""Operator server: flags, metrics endpoint, leader election, controller run.
+
+Re-architecture of the reference's process entry point
+(/root/reference/cmd/tf-operator.v1/main.go:32-68 and app/server.go:71-187):
+same operational surface — `--namespace`, `--threadiness`,
+`--enable-gang-scheduling`, `--monitoring-port`, `--resync-period`,
+`--json-log-format`, leader election with an is-leader gauge, /metrics +
+/healthz HTTP — with the substrate behind ClusterInterface (local process
+runtime by default here; a Kubernetes backend slots in unchanged).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .. import __version__
+from ..controller.controller import TPUJobController
+from ..runtime.cluster import ClusterInterface, InMemoryCluster
+from ..runtime.local import LocalProcessCluster
+from ..runtime.reconciler import ReconcilerConfig
+from ..utils import logging as tpulog
+from ..utils import metrics
+
+# Leader-election timing (ref: server.go:53-58).
+LEASE_DURATION = 15.0
+RENEW_PERIOD = 5.0
+RETRY_PERIOD = 3.0
+LEASE_NAME = "tpu-operator-leader"
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    """(ref: ServerOption.AddFlags, app/options/options.go:53-83)"""
+    parser = argparse.ArgumentParser("tpu-operator")
+    parser.add_argument("--namespace", default="",
+                        help="namespace to watch; empty = all namespaces")
+    parser.add_argument("--threadiness", type=int, default=1)
+    parser.add_argument("--version", action="version",
+                        version=f"tpu-operator {__version__}")
+    parser.add_argument("--json-log-format", action="store_true", default=True)
+    parser.add_argument("--no-json-log-format", dest="json_log_format",
+                        action="store_false")
+    parser.add_argument("--enable-gang-scheduling", action="store_true")
+    parser.add_argument("--gang-scheduler-name", default="tpu-gang")
+    parser.add_argument("--monitoring-port", type=int, default=8443)
+    parser.add_argument("--api-port", type=int, default=8008,
+                        help="REST API port; 0 disables")
+    parser.add_argument("--resync-period", type=float, default=15.0)
+    parser.add_argument("--enable-leader-election", action="store_true")
+    parser.add_argument("--workdir", default=".tpujob-local",
+                        help="local runtime workdir (logs, state)")
+    parser.add_argument("--runtime", choices=("local", "memory"), default="local")
+    return parser
+
+
+class MonitoringHandler(BaseHTTPRequestHandler):
+    server_version = "tpu-operator"
+
+    def do_GET(self):  # noqa: N802
+        if self.path == "/metrics":
+            body = metrics.REGISTRY.render().encode()
+            ctype = "text/plain; version=0.0.4"
+        elif self.path == "/healthz":
+            body = b"ok"
+            ctype = "text/plain"
+        elif self.path == "/debug/threads":
+            # The pprof-parity endpoint (ref: main.go:21 net/http/pprof).
+            import sys, traceback  # noqa: E401
+
+            frames = sys._current_frames()
+            lines = []
+            for t in threading.enumerate():
+                lines.append(f"--- {t.name} ({t.ident}) ---")
+                frame = frames.get(t.ident)
+                if frame:
+                    lines.extend(traceback.format_stack(frame))
+            body = "\n".join(lines).encode()
+            ctype = "text/plain"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):  # silence per-request spam
+        pass
+
+
+def start_monitoring(port: int) -> ThreadingHTTPServer:
+    """(ref: startMonitoring, main.go:39-50)"""
+    server = ThreadingHTTPServer(("127.0.0.1", port), MonitoringHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="monitoring")
+    thread.start()
+    return server
+
+
+class LeaderElector:
+    """Lease-based leader election (ref: leaderelection.RunOrDie,
+    server.go:159-184).  Losing a held lease is fatal, matching the
+    reference's restart-the-process recovery model."""
+
+    def __init__(self, cluster: ClusterInterface, identity: str,
+                 on_started_leading, on_lost_lease) -> None:
+        self.cluster = cluster
+        self.identity = identity
+        self.on_started_leading = on_started_leading
+        self.on_lost_lease = on_lost_lease
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        leading = False
+        while not self._stop.is_set():
+            acquired = self.cluster.try_acquire_lease(
+                LEASE_NAME, self.identity, LEASE_DURATION
+            )
+            if acquired and not leading:
+                leading = True
+                metrics.is_leader.labels().set(1)
+                self.on_started_leading()
+            elif not acquired and leading:
+                metrics.is_leader.labels().set(0)
+                self.on_lost_lease()
+                return
+            elif not acquired:
+                metrics.is_leader.labels().set(0)
+            self._stop.wait(RENEW_PERIOD if leading else RETRY_PERIOD)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobController:
+    """Build everything and run the controller (blocking).  `cluster` may be
+    injected for tests (ref: app.Run, server.go:71-187)."""
+    args = build_arg_parser().parse_args(argv)
+    tpulog.configure(json_format=args.json_log_format, level=logging.INFO)
+    log = tpulog.logger_for_key("server")
+
+    if cluster is None:
+        cluster = (
+            LocalProcessCluster(workdir=args.workdir)
+            if args.runtime == "local"
+            else InMemoryCluster()
+        )
+
+    config = ReconcilerConfig(
+        reconciler_sync_loop_period=args.resync_period,
+        enable_gang_scheduling=args.enable_gang_scheduling,
+        gang_scheduler_name=args.gang_scheduler_name,
+    )
+    resolver_owner = cluster if hasattr(cluster, "resolver") else None
+    controller = TPUJobController(
+        cluster,
+        config=config,
+        threadiness=args.threadiness,
+        **({"resolver": resolver_owner.resolver} if resolver_owner else {}),
+    )
+
+    monitoring = start_monitoring(args.monitoring_port)
+    log.info("monitoring on 127.0.0.1:%d (/metrics /healthz /debug/threads)",
+             args.monitoring_port)
+    api = None
+    if args.api_port:
+        from .api_server import start_api_server
+
+        api = start_api_server(cluster, args.api_port)
+        log.info("REST API on 127.0.0.1:%d", args.api_port)
+
+    if args.enable_leader_election:
+        import os
+        import socket
+
+        identity = f"{socket.gethostname()}-{os.getpid()}"
+        fatal = {"lost": False}
+
+        def on_lost():
+            # (ref: server.go:179-182 — lease loss is fatal)
+            log.error("leader election lost; exiting")
+            fatal["lost"] = True
+            controller.stop()
+
+        elector = LeaderElector(cluster, identity, controller.start, on_lost)
+        try:
+            elector.run()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            elector.stop()
+            controller.stop()
+            monitoring.shutdown()
+            if api:
+                api.shutdown()
+        if fatal["lost"]:
+            raise SystemExit(1)
+    else:
+        metrics.is_leader.labels().set(1)
+        try:
+            controller.run()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            controller.stop()
+            monitoring.shutdown()
+            if api:
+                api.shutdown()
+    return controller

@@ -1,0 +1,88 @@
+"""Checkpoint / resume.
+
+The reference deliberately keeps checkpointing out of the operator and relies
+on (a) stable pod identity and (b) volume passthrough so user containers can
+save/restore (SURVEY.md §5).  This framework owns the training runtime, so it
+ships the other half: orbax-backed save/restore of TrainState keyed by step,
+with the same contract the restart state machine needs — a preempted gang
+that restarts (ExitCode/137) resumes from the latest step.
+
+Orbax handles sharded arrays natively: on restore the target shardings come
+from the live TrainState template, so a checkpoint written on one mesh can be
+read on another (elastic resume).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+from .state import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self._mgr = None
+
+    def _manager(self):
+        if self._mgr is None:
+            import orbax.checkpoint as ocp
+
+            self._mgr = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=self.max_to_keep, create=True
+                ),
+            )
+        return self._mgr
+
+    def save(self, state: TrainState, step: Optional[int] = None, wait: bool = True) -> int:
+        import jax
+        import orbax.checkpoint as ocp
+
+        step = int(state.step) if step is None else step
+        payload = {
+            "params": state.params,
+            "opt_state": state.opt_state,
+            "step": state.step,
+        }
+        if state.batch_stats is not None:
+            payload["batch_stats"] = state.batch_stats
+        self._manager().save(step, args=ocp.args.StandardSave(payload))
+        if wait:
+            self._manager().wait_until_finished()
+        return step
+
+    def latest_step(self) -> Optional[int]:
+        return self._manager().latest_step()
+
+    def restore(self, template: TrainState, step: Optional[int] = None) -> TrainState:
+        """Restore into the template's structure/shardings; returns a new
+        TrainState (template unchanged if no checkpoint exists)."""
+        import orbax.checkpoint as ocp
+
+        step = self.latest_step() if step is None else step
+        if step is None:
+            return template
+        target = {
+            "params": template.params,
+            "opt_state": template.opt_state,
+            "step": template.step,
+        }
+        if template.batch_stats is not None:
+            target["batch_stats"] = template.batch_stats
+        restored = self._manager().restore(
+            step, args=ocp.args.StandardRestore(target)
+        )
+        return template.replace(
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+            step=restored["step"],
+            batch_stats=restored.get("batch_stats", template.batch_stats),
+        )
+
+    def close(self) -> None:
+        if self._mgr is not None:
+            self._mgr.close()

@@ -1,0 +1,58 @@
+"""Train state: params + optimizer + mutable model collections."""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Like flax.training.train_state.TrainState plus batch_stats (for
+    BatchNorm models) and an explicit apply_fn kept out of the pytree."""
+
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    batch_stats: Any = None
+    apply_fn: Callable = flax.struct.field(pytree_node=False, default=None)
+    tx: optax.GradientTransformation = flax.struct.field(
+        pytree_node=False, default=None
+    )
+
+    def apply_gradients(self, grads, new_batch_stats=None) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            batch_stats=(
+                new_batch_stats if new_batch_stats is not None else self.batch_stats
+            ),
+        )
+
+
+def create_train_state(
+    rng: jax.Array,
+    model,
+    tx: optax.GradientTransformation,
+    example_input,
+    extra_init_args: tuple = (),
+    init_kwargs: Optional[dict] = None,
+) -> TrainState:
+    variables = model.init(rng, example_input, *extra_init_args, **(init_kwargs or {}))
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")
+    import jax.numpy as jnp
+
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        opt_state=tx.init(params),
+        batch_stats=batch_stats,
+        apply_fn=model.apply,
+        tx=tx,
+    )

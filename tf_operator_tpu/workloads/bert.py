@@ -1,0 +1,80 @@
+"""BERT fine-tune workload (BASELINE config 4): sequence classification.
+
+Usage: python -m tf_operator_tpu.workloads.bert --steps 50
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--batch", type=int, default=32)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=5e-5)
+    parser.add_argument("--layers", type=int, default=12)
+    parser.add_argument("--d-model", type=int, default=768)
+    args = parser.parse_args(argv)
+
+    forced = os.environ.get("TPUJOB_FORCE_PLATFORM")
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
+
+    from .runner import WorkloadContext
+
+    ctx = WorkloadContext.from_env()
+    print(f"bert workload: role={ctx.replica_type} index={ctx.replica_index}",
+          flush=True)
+    ctx.initialize_distributed()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ..models.transformer import BertEncoder, bert_base_config
+    from ..train.state import create_train_state
+    from ..train.step import (
+        classification_loss_fn,
+        make_train_step,
+        shard_batch,
+        shard_train_state,
+    )
+
+    mesh = ctx.build_mesh()
+    cfg = bert_base_config(
+        num_layers=args.layers, d_model=args.d_model,
+        num_heads=max(1, args.d_model // 64), d_ff=args.d_model * 4,
+        max_len=args.seq_len, mesh=mesh,
+    )
+    model = BertEncoder(cfg, num_labels=2)
+
+    def apply_logits(variables, tokens, **kw):
+        return model.apply(variables, tokens, **kw)["logits"]
+
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, optax.adamw(args.lr),
+        jnp.zeros((2, args.seq_len), jnp.int32),
+    )
+    state = shard_train_state(state, mesh)
+    step = make_train_step(classification_loss_fn(apply_logits))
+    rng = np.random.RandomState(ctx.replica_index)
+    for i in range(args.steps):
+        batch = {
+            "x": rng.randint(0, cfg.vocab_size, (args.batch, args.seq_len)).astype(np.int32),
+            "label": rng.randint(0, 2, args.batch).astype(np.int32),
+        }
+        state, metrics = step(state, shard_batch(batch, mesh))
+        if i % 10 == 0:
+            print(f"step {i} loss {float(metrics['loss']):.4f}", flush=True)
+    print("done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

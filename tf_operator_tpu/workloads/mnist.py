@@ -1,0 +1,86 @@
+"""MNIST training workload — the pod-side program for BASELINE configs 1-2.
+
+The single-worker shape mirrors examples/v1/mnist_with_summaries (one process,
+no TF_CONFIG); the distributed shape consumes the injected topology like
+examples/v1/dist-mnist/dist_mnist.py does: PS replicas park as (stub)
+parameter servers, workers train.  On the JAX path parameters ride XLA
+collectives instead of PS gRPC, so PS processes simply idle until workers
+finish — kept for drop-in topology parity with reference jobs that declare PS
+replicas.
+
+Usage: python -m tf_operator_tpu.workloads.mnist --steps 100 [--batch 64]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--model", choices=("mlp", "cnn"), default="mlp")
+    parser.add_argument("--target-loss", type=float, default=None)
+    args = parser.parse_args(argv)
+
+    import os
+
+    # Test hook: the local runtime forces CPU for pod subprocesses so they
+    # don't contend for the host's TPU (sitecustomize pins jax_platforms,
+    # so env alone is not enough — see tests/conftest.py).
+    forced = os.environ.get("TPUJOB_FORCE_PLATFORM")
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
+
+    from .runner import WorkloadContext
+
+    ctx = WorkloadContext.from_env()
+    print(f"mnist workload: role={ctx.replica_type} index={ctx.replica_index} "
+          f"nproc={ctx.num_processes}", flush=True)
+
+    if ctx.replica_type == "ps":
+        # Parameter servers have no work on the XLA path; wait for the
+        # controller to reap us when workers complete (CleanPodPolicy).
+        while True:
+            time.sleep(1)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..models.mnist import MnistCNN, MnistMLP
+    from ..train.data import synthetic_mnist
+    from ..train.state import create_train_state
+    from ..train.step import classification_loss_fn, make_train_step
+
+    model = MnistMLP() if args.model == "mlp" else MnistCNN()
+    init_kwargs = {} if args.model == "mlp" else {"train": False}
+    state = create_train_state(
+        jax.random.PRNGKey(ctx.replica_index), model, optax.adam(args.lr),
+        jnp.zeros((2, 784)), init_kwargs=init_kwargs,
+    )
+    model_kwargs = {} if args.model == "mlp" else {"train": False}
+    step = make_train_step(
+        classification_loss_fn(model.apply, model_kwargs=model_kwargs)
+    )
+    data = synthetic_mnist(args.batch, seed=ctx.replica_index)
+    loss = float("inf")
+    for i in range(args.steps):
+        state, metrics = step(state, next(data))
+        loss = float(metrics["loss"])
+        if i % 10 == 0:
+            print(f"step {i} loss {loss:.4f}", flush=True)
+    print(f"final loss {loss:.4f}", flush=True)
+    if args.target_loss is not None and loss > args.target_loss:
+        print(f"target loss {args.target_loss} not reached", flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""Workload bootstrap: what a pod process does with the injected topology.
+
+The reference's user containers read TF_CONFIG to self-assemble a TF cluster
+(e.g. examples/v1/dist-mnist/dist_mnist.py:102-143; echoed by the E2E
+test-server, test/test-server/test_app.py:31-33).  This module is the
+JAX-side equivalent: parse TF_CONFIG + the TPUJOB_* env into a WorkloadContext
+(role, index, coordinator, process id/count, mesh shape), optionally call
+`jax.distributed.initialize`, and build the assigned mesh.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from ..api import constants
+
+
+@dataclass
+class WorkloadContext:
+    replica_type: str = "worker"
+    replica_index: int = 0
+    tf_config: Optional[dict] = None
+    coordinator_address: Optional[str] = None
+    process_id: Optional[int] = None
+    num_processes: int = 1
+    mesh_shape: Dict[str, int] = field(default_factory=dict)
+    accelerator: str = ""
+    slice_topology: str = ""
+
+    @property
+    def is_coordinator(self) -> bool:
+        return (self.process_id or 0) == 0
+
+    @classmethod
+    def from_env(cls, env: Optional[Dict[str, str]] = None) -> "WorkloadContext":
+        env = dict(os.environ if env is None else env)
+        tf_config = None
+        raw = env.get(constants.ENV_TF_CONFIG)
+        if raw:
+            tf_config = json.loads(raw)
+        mesh_raw = env.get(constants.ENV_MESH_SHAPE, "")
+        pid = env.get(constants.ENV_PROCESS_ID)
+        ctx = cls(
+            replica_type=env.get(constants.ENV_REPLICA_TYPE, "worker"),
+            replica_index=int(env.get(constants.ENV_REPLICA_INDEX, "0")),
+            tf_config=tf_config,
+            coordinator_address=env.get(constants.ENV_COORDINATOR_ADDRESS),
+            process_id=int(pid) if pid is not None else None,
+            num_processes=int(env.get(constants.ENV_NUM_PROCESSES, "1")),
+            mesh_shape=json.loads(mesh_raw) if mesh_raw else {},
+            accelerator=env.get(constants.ENV_ACCELERATOR, ""),
+            slice_topology=env.get(constants.ENV_SLICE_TOPOLOGY, ""),
+        )
+        # TF_CONFIG task block wins when present (parity with the reference's
+        # contract: the task identity is authoritative there).
+        if tf_config and "task" in tf_config:
+            ctx.replica_type = tf_config["task"].get("type", ctx.replica_type)
+            ctx.replica_index = int(tf_config["task"].get("index", ctx.replica_index))
+        return ctx
+
+    def initialize_distributed(self) -> bool:
+        """Call jax.distributed.initialize for multi-host meshes; no-op for
+        single-process jobs (returns whether it initialized)."""
+        if self.num_processes <= 1 or self.process_id is None:
+            return False
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=self.coordinator_address,
+            num_processes=self.num_processes,
+            process_id=self.process_id,
+        )
+        return True
+
+    def build_mesh(self):
+        from ..parallel.mesh import build_mesh
+
+        return build_mesh(self.mesh_shape or None)

@@ -1,0 +1,76 @@
+"""Controllable test workload — the E2E determinism lever.
+
+Re-architecture of the reference's test-server flask app
+(/root/reference/test/test-server/test_app.py): it exposed /tfconfig (echo
+env), /runconfig, and /exit?exitCode=N through the apiserver proxy.  Here the
+control channel is the filesystem (no cluster proxy exists locally): the
+process dumps its view of the topology to `<ctrl>/<pod>.env.json` on start,
+then polls `<ctrl>/<pod>.cmd` (falling back to `<ctrl>/all.cmd`) for:
+
+    exit <code>     terminate with that exit code
+    sleep <secs>    keep running this much longer, then exit 0
+
+Usage:  python -m tf_operator_tpu.workloads.test_server --ctrl-dir DIR \
+            --pod-name NAME [--auto-exit-after SECS [--auto-exit-code N]]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--ctrl-dir", required=True)
+    parser.add_argument("--pod-name", default=os.environ.get("POD_NAME", "pod"))
+    parser.add_argument("--auto-exit-after", type=float, default=None)
+    parser.add_argument("--auto-exit-code", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    os.makedirs(args.ctrl_dir, exist_ok=True)
+    # /tfconfig analogue: publish the env view for test assertions.
+    view = {
+        key: value
+        for key, value in os.environ.items()
+        if key.startswith("TPUJOB_") or key == "TF_CONFIG"
+    }
+    with open(os.path.join(args.ctrl_dir, f"{args.pod_name}.env.json"), "w") as f:
+        json.dump(view, f, indent=2)
+
+    deadline = (
+        time.time() + args.auto_exit_after if args.auto_exit_after is not None else None
+    )
+    cmd_paths = [
+        os.path.join(args.ctrl_dir, f"{args.pod_name}.cmd"),
+        os.path.join(args.ctrl_dir, "all.cmd"),
+    ]
+    seen_mtime = {}
+    while True:
+        for path in cmd_paths:
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            if seen_mtime.get(path) == mtime:
+                continue
+            seen_mtime[path] = mtime
+            with open(path) as f:
+                parts = f.read().split()
+            if not parts:
+                continue
+            if parts[0] == "exit":
+                code = int(parts[1]) if len(parts) > 1 else 0
+                print(f"test-server {args.pod_name}: exit {code}", flush=True)
+                return code
+            if parts[0] == "sleep":
+                deadline = time.time() + float(parts[1])
+        if deadline is not None and time.time() >= deadline:
+            return args.auto_exit_code
+        time.sleep(0.05)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
