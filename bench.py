@@ -25,13 +25,17 @@ WARMUP = 3
 
 
 def _throughput(step_fn, state, batch, steps: int) -> float:
+    # Block on the FULL output state, not just the scalar loss: the last
+    # step's backward+update would otherwise still be in flight and async
+    # dispatch can overlap the host loop (measured 5x-over-roofline numbers
+    # without this).
     for _ in range(WARMUP):
         state, metrics = step_fn(state, batch)
-    jax_block(metrics)
+    jax_block(state)
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step_fn(state, batch)
-    jax_block(metrics)
+    jax_block(state)
     return steps / (time.perf_counter() - t0)
 
 
@@ -39,7 +43,8 @@ def jax_block(tree):
     import jax
 
     for leaf in jax.tree_util.tree_leaves(tree):
-        leaf.block_until_ready()
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
 
 
 def main() -> None:
@@ -97,11 +102,11 @@ def main() -> None:
     bare_state = (params, batch_stats, opt_state)
     for _ in range(WARMUP):
         bare_state, m = bare_step(bare_state, batch)
-    jax_block(m)
+    jax_block(bare_state)
     t0 = time.perf_counter()
     for _ in range(STEPS):
         bare_state, m = bare_step(bare_state, batch)
-    jax_block(m)
+    jax_block(bare_state)
     bare_sps = STEPS / (time.perf_counter() - t0)
 
     images_per_sec = fw_sps * BATCH

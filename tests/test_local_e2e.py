@@ -201,3 +201,73 @@ def test_real_mnist_training_job(local_stack):
     logs = client.get_logs("mnist-single")
     assert client.is_job_succeeded("mnist-single"), logs
     assert any("final loss" in t for t in logs.values())
+
+
+@pytest.mark.slow
+def test_multiprocess_jax_distributed_collective(local_stack):
+    """Two controller-launched worker processes form a real jax.distributed
+    group via the injected coordinator env and run an allgather — the
+    distributed-communication-backend contract, end to end."""
+    cluster, controller, client, tmp = local_stack
+    job = TPUJob(
+        metadata=ObjectMeta(name="allreduce"),
+        spec=TPUJobSpec(replica_specs={
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=2,
+                template=PodTemplateSpec(containers=[Container(
+                    name="tensorflow", image="local",
+                    command=[sys.executable, "-m",
+                             "tf_operator_tpu.workloads.allreduce_check"],
+                )]),
+            )
+        }),
+    )
+    client.create(job)
+    client.wait_for_job("allreduce", timeout=180)
+    logs = client.get_logs("allreduce")
+    assert client.is_job_succeeded("allreduce"), logs
+    assert any("allreduce_check OK" in text for text in logs.values()), logs
+
+
+@pytest.mark.slow
+def test_dist_mnist_parameter_server_job(local_stack):
+    """2 PS + 2 workers with REAL async PS training (BASELINE config 2 /
+    reference dist-mnist shape): workers pull/push over the injected
+    TF_CONFIG addresses; worker-0 completion marks the job Succeeded and
+    CleanPodPolicy reaps the parked PS pods."""
+    cluster, controller, client, tmp = local_stack
+    container = Container(
+        name="tensorflow", image="local",
+        command=[sys.executable, "-m", "tf_operator_tpu.workloads.dist_mnist"],
+        args=["--steps", "30", "--target-loss", "1.5"],
+    )
+    job = TPUJob(
+        metadata=ObjectMeta(name="dist-mnist"),
+        spec=TPUJobSpec(replica_specs={
+            ReplicaType.PS: ReplicaSpec(
+                replicas=2,
+                template=PodTemplateSpec(containers=[
+                    Container(name="tensorflow", image="local",
+                              command=container.command, args=["--steps", "30"])
+                ]),
+            ),
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=2,
+                template=PodTemplateSpec(containers=[container]),
+            ),
+        }),
+    )
+    client.create(job)
+    client.wait_for_job("dist-mnist", timeout=300)
+    logs = client.get_logs("dist-mnist")
+    assert client.is_job_succeeded("dist-mnist"), logs
+    worker_logs = client.get_logs("dist-mnist", replica_type="worker")
+    assert any("final loss" in t for t in worker_logs.values()), worker_logs
+    # PS pods reaped by CleanPodPolicy(Running) after terminal state
+    assert wait_until(
+        lambda: all(
+            p.status.phase.value != "Running"
+            for p in cluster.list_pods(selector={"job-name": "dist-mnist"})
+        ),
+        timeout=30,
+    )

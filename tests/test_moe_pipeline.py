@@ -1,0 +1,172 @@
+"""Expert parallelism (MoE) and pipeline parallelism tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tf_operator_tpu.models.pipeline_lm import PipelinedTransformerLM
+from tf_operator_tpu.models.transformer import Block, TransformerConfig, TransformerLM
+from tf_operator_tpu.parallel.mesh import build_mesh
+from tf_operator_tpu.parallel.moe import top_k_gating
+from tf_operator_tpu.parallel.pipeline import gpipe
+from tf_operator_tpu.parallel.tp_rules import make_param_shardings
+from tf_operator_tpu.train.data import synthetic_tokens
+from tf_operator_tpu.train.state import create_train_state
+from tf_operator_tpu.train.step import (
+    lm_loss_fn,
+    make_train_step,
+    shard_batch,
+    shard_train_state,
+)
+
+
+class TestGating:
+    def test_each_token_dispatched_at_most_k(self):
+        logits = jax.random.normal(jax.random.PRNGKey(0), (32, 8))
+        dispatch, combine, aux = top_k_gating(logits, k=2, capacity=16)
+        per_token = np.asarray(dispatch.sum(axis=(1, 2)))
+        assert per_token.max() <= 2 + 1e-6
+        assert np.isfinite(float(aux))
+
+    def test_capacity_respected(self):
+        # all tokens prefer expert 0; capacity forces drops
+        logits = jnp.zeros((16, 4)).at[:, 0].set(10.0)
+        dispatch, _, _ = top_k_gating(logits, k=1, capacity=4)
+        per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+        assert per_expert[0] <= 4 + 1e-6
+
+    def test_combine_weights_are_gate_probs(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (8, 4))
+        probs = np.asarray(jax.nn.softmax(logits, -1))
+        dispatch, combine, _ = top_k_gating(logits, k=1, capacity=8)
+        picked = np.asarray(combine.sum(axis=(1, 2)))
+        np.testing.assert_allclose(picked, probs.max(-1), atol=1e-5)
+
+
+def test_moe_lm_trains_with_ep_mesh():
+    mesh = build_mesh({"dp": 2, "ep": 4})
+    cfg = TransformerConfig(
+        vocab_size=128, num_layers=2, num_heads=4, d_model=32, d_ff=64,
+        max_len=32, dtype=jnp.float32, mesh=mesh,
+        moe_num_experts=4, moe_every=2,
+    )
+    model = TransformerLM(cfg)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, optax.adam(1e-3), jnp.zeros((2, 16), jnp.int32)
+    )
+    shardings = make_param_shardings(state.params, mesh)
+    assert shardings["block_1"]["moe"]["wi"].spec == P("ep")
+    state = shard_train_state(state, mesh)
+    step = make_train_step(lm_loss_fn(model.apply, moe_aux_weight=0.01))
+    data = synthetic_tokens(8, 33, vocab_size=128)
+    losses = []
+    for _ in range(5):
+        state, metrics = step(state, shard_batch(next(data), mesh))
+        losses.append(float(metrics["loss"]))
+        assert "moe_aux_loss" in metrics and np.isfinite(float(metrics["moe_aux_loss"]))
+    assert losses[-1] < losses[0]
+
+
+class TestGPipe:
+    def test_matches_sequential(self):
+        mesh = build_mesh({"pp": 4, "dp": 2})
+        d = 16
+        weights = jax.random.normal(jax.random.PRNGKey(0), (4, d, d)) * 0.3
+        biases = jax.random.normal(jax.random.PRNGKey(1), (4, d)) * 0.1
+        params = {"w": weights, "b": biases}
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, d))
+        out = gpipe(stage_fn, params, x, mesh, num_microbatches=4)
+        ref = x
+        for i in range(4):
+            ref = jnp.tanh(ref @ weights[i] + biases[i])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    def test_grad_flows(self):
+        mesh = build_mesh({"pp": 2, "dp": 4})
+        d = 8
+        weights = jax.random.normal(jax.random.PRNGKey(0), (2, d, d)) * 0.3
+        params = {"w": weights, "b": jnp.zeros((2, d))}
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        x = jax.random.normal(jax.random.PRNGKey(2), (4, d))
+        grads = jax.grad(
+            lambda w: jnp.sum(gpipe(stage_fn, {"w": w, "b": params["b"]}, x, mesh, 2) ** 2)
+        )(weights)
+        assert np.isfinite(np.asarray(grads)).all()
+        assert float(jnp.linalg.norm(grads)) > 0
+
+    def test_bad_microbatch_raises(self):
+        mesh = build_mesh({"pp": 2, "dp": 4})
+        params = {"w": jnp.zeros((2, 4, 4))}
+        with pytest.raises(ValueError):
+            gpipe(lambda p, x: x, params, jnp.zeros((5, 4)), mesh, 3)
+
+
+class TestPipelinedLM:
+    def test_matches_sequential_blocks(self):
+        mesh = build_mesh({"pp": 4, "dp": 2})
+        cfg = TransformerConfig(
+            vocab_size=64, num_layers=4, num_heads=2, d_model=16, d_ff=32,
+            max_len=16, dtype=jnp.float32,
+        )
+        model = PipelinedTransformerLM(cfg, mesh, num_microbatches=2)
+        params = model.shard_params(model.init(jax.random.PRNGKey(0)))
+        tokens = jnp.arange(4 * 16, dtype=jnp.int32).reshape(4, 16) % 64
+        logits = model.apply(params, tokens)
+
+        block = Block(cfg)
+        x = params["wte"][tokens] + params["wpe"][None, :16, :]
+        stages = jax.device_get(params["stages"])
+        for s in range(4):
+            layer = jax.tree_util.tree_map(lambda a: a[s, 0], stages)
+            x = block.apply({"params": layer}, x)
+        x32 = x.astype(jnp.float32)
+        x32 = (x32 - x32.mean(-1, keepdims=True)) * jax.lax.rsqrt(
+            x32.var(-1, keepdims=True) + 1e-5
+        )
+        x32 = x32 * params["ln_f_scale"] + params["ln_f_bias"]
+        ref = x32 @ jax.device_get(params["wte"]).T
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=1e-4)
+
+    def test_layers_must_divide_stages(self):
+        mesh = build_mesh({"pp": 4, "dp": 2})
+        cfg = TransformerConfig(num_layers=3, d_model=16, num_heads=2, d_ff=32,
+                                vocab_size=32, max_len=8, dtype=jnp.float32)
+        with pytest.raises(ValueError):
+            PipelinedTransformerLM(cfg, mesh)
+
+    def test_training_step(self):
+        mesh = build_mesh({"pp": 2, "dp": 4})
+        cfg = TransformerConfig(
+            vocab_size=64, num_layers=4, num_heads=2, d_model=16, d_ff=32,
+            max_len=16, dtype=jnp.float32,
+        )
+        model = PipelinedTransformerLM(cfg, mesh, num_microbatches=2)
+        params = model.shard_params(model.init(jax.random.PRNGKey(0)))
+        tokens = jnp.arange(4 * 16, dtype=jnp.int32).reshape(4, 16) % 64
+
+        @jax.jit
+        def step(p):
+            def loss_fn(p):
+                logits = model.apply(p, tokens)
+                logp = jax.nn.log_softmax(logits, -1)
+                return -jnp.mean(
+                    jnp.take_along_axis(logp[:, :-1], tokens[:, 1:, None], -1)
+                )
+
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            return jax.tree_util.tree_map(lambda a, g: a - 1e-2 * g, p, grads), loss
+
+        losses = []
+        for _ in range(4):
+            params, loss = step(params)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]

@@ -1,0 +1,90 @@
+"""Async parameter-server runtime tests (train/ps.py)."""
+import threading
+
+import numpy as np
+
+from tf_operator_tpu.train.ps import (
+    ParameterServer,
+    PSClient,
+    flatten_params,
+    shard_names,
+    unflatten_params,
+)
+
+
+def start_server(params, lr=0.5):
+    server = ParameterServer(("127.0.0.1", 0), params, lr=lr)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    host, port = server.server_address
+    return server, f"127.0.0.1:{port}"
+
+
+def test_pull_push_round_trip():
+    server, addr = start_server({"w": np.ones(4, np.float32)}, lr=0.5)
+    client = PSClient([addr])
+    params = client.pull()
+    np.testing.assert_array_equal(params["w"], np.ones(4))
+    client.push({"w": np.full(4, 2.0, np.float32)})
+    updated = client.pull()["w"]
+    np.testing.assert_allclose(updated, np.ones(4) - 0.5 * 2.0)
+    client.close()
+    server.shutdown()
+
+
+def test_sharding_across_servers():
+    names = ["a", "b", "c", "d", "e"]
+    s0 = shard_names(names, 2, 0)
+    s1 = shard_names(names, 2, 1)
+    assert sorted(s0 + s1) == sorted(names)
+    assert not set(s0) & set(s1)
+
+    all_params = {n: np.full(2, i, np.float32) for i, n in enumerate(names)}
+    servers, addrs = [], []
+    for idx in range(2):
+        shard = {n: all_params[n] for n in shard_names(names, 2, idx)}
+        server, addr = start_server(shard)
+        servers.append(server)
+        addrs.append(addr)
+    client = PSClient(addrs)
+    merged = client.pull()
+    assert sorted(merged) == sorted(names)
+    # push routes each leaf to its owning shard only
+    client.push({n: np.ones(2, np.float32) for n in names}, num_ps=2)
+    after = client.pull()
+    for name in names:
+        np.testing.assert_allclose(after[name], all_params[name] - 0.5)
+    client.close()
+    for server in servers:
+        server.shutdown()
+
+
+def test_concurrent_pushes_all_applied():
+    server, addr = start_server({"w": np.zeros(1, np.float32)}, lr=1.0)
+
+    def pusher():
+        client = PSClient([addr])
+        for _ in range(20):
+            client.push({"w": np.full(1, -1.0, np.float32)})
+        client.close()
+
+    threads = [threading.Thread(target=pusher) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    client = PSClient([addr])
+    final = client.pull()["w"]
+    client.close()
+    server.shutdown()
+    np.testing.assert_allclose(final, [80.0])  # 4 threads x 20 pushes x lr*1
+
+
+def test_flatten_unflatten():
+    tree = {"dense": {"kernel": np.ones((2, 2)), "bias": np.zeros(2)},
+            "out": {"kernel": np.full((2, 1), 3.0)}}
+    flat = flatten_params(tree)
+    assert set(flat) == {"dense/kernel", "dense/bias", "out/kernel"}
+    back = unflatten_params(flat)
+    np.testing.assert_array_equal(back["dense"]["kernel"], tree["dense"]["kernel"])
+    np.testing.assert_array_equal(back["out"]["kernel"], tree["out"]["kernel"])

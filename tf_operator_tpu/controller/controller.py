@@ -217,6 +217,16 @@ class TPUJobController(JobPlugin):
     def sync_job(self, key: str) -> bool:
         """One reconcile pass for `key` (ref: syncTFJob, controller.go:290-334).
         Returns True if a reconcile ran (expectations satisfied)."""
+        start = time.time()
+        try:
+            return self._sync_job(key)
+        finally:
+            # Per-sync latency log (ref: controller.go:291-295).
+            tpulog.logger_for_key(key).debug(
+                "finished syncing tpujob (%.1f ms)", (time.time() - start) * 1e3
+            )
+
+    def _sync_job(self, key: str) -> bool:
         namespace, _, name = key.partition("/")
         try:
             job = self.cluster.get_job(namespace, name)

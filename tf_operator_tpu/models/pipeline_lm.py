@@ -1,0 +1,105 @@
+"""Pipeline-parallel Transformer LM.
+
+Functional (non-Module) model: embedding and head run data-parallel on every
+device; the block stack runs as an SPMD GPipe over the `pp` mesh axis
+(parallel/pipeline.py) with one transformer Block per stage, params stacked
+on a leading stage dimension and sharded over `pp`.  Composes with dp (batch
+dim) and the block's own tp rules are inapplicable here by design — pp and
+tp address different scaling regimes; pick per job via the mesh.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.pipeline import gpipe
+from .transformer import Block, TransformerConfig
+
+
+class PipelinedTransformerLM:
+    def __init__(self, cfg: TransformerConfig, mesh: Mesh,
+                 num_microbatches: int = 4, pp_axis: str = "pp") -> None:
+        if cfg.mesh is not None and cfg.ring_axis in (cfg.mesh.axis_names or ()):
+            pass  # ring attention inside blocks composes with pp
+        self.cfg = cfg
+        self.mesh = mesh
+        self.num_microbatches = num_microbatches
+        self.pp_axis = pp_axis
+        self.num_stages = mesh.shape[pp_axis]
+        if cfg.num_layers % self.num_stages:
+            raise ValueError(
+                f"num_layers {cfg.num_layers} must divide by pipeline stages {self.num_stages}"
+            )
+        self.layers_per_stage = cfg.num_layers // self.num_stages
+        self._block = Block(cfg)
+
+    # ------------------------------------------------------------------
+
+    def init(self, rng: jax.Array) -> Dict[str, Any]:
+        cfg = self.cfg
+        keys = jax.random.split(rng, cfg.num_layers + 2)
+        dummy = jnp.zeros((1, cfg.max_len, cfg.d_model), cfg.dtype)
+        layer_params = [
+            self._block.init(keys[i], dummy)["params"] for i in range(cfg.num_layers)
+        ]
+        # [stages, layers_per_stage, ...] leaves
+        def stack(*leaves):
+            flat = jnp.stack(leaves)
+            return flat.reshape(self.num_stages, self.layers_per_stage, *flat.shape[1:])
+
+        stages = jax.tree_util.tree_map(stack, *layer_params)
+        params = {
+            "wte": jax.random.normal(keys[-1], (cfg.vocab_size, cfg.d_model)) * 0.02,
+            "wpe": jax.random.normal(keys[-2], (cfg.max_len, cfg.d_model)) * 0.02,
+            "ln_f_scale": jnp.ones((cfg.d_model,)),
+            "ln_f_bias": jnp.zeros((cfg.d_model,)),
+            "stages": stages,
+        }
+        return params
+
+    def shard_params(self, params):
+        """Stage dim over pp; everything else replicated."""
+        def place(path, leaf):
+            top = str(getattr(path[0], "key", ""))
+            if top == "stages":
+                spec = P(self.pp_axis, *([None] * (leaf.ndim - 1)))
+            else:
+                spec = P()
+            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        return jax.tree_util.tree_unflatten(
+            treedef, [place(path, leaf) for path, leaf in flat]
+        )
+
+    # ------------------------------------------------------------------
+
+    def _stage_fn(self, stage_params, x):
+        """Apply this stage's layers_per_stage blocks sequentially."""
+        def body(x, layer_params):
+            return self._block.apply({"params": layer_params}, x), None
+
+        x, _ = jax.lax.scan(
+            lambda carry, lp: body(carry, lp), x, stage_params
+        )
+        return x
+
+    def apply(self, params, tokens: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        b, t = tokens.shape
+        x = params["wte"][tokens] + params["wpe"][None, :t, :]
+        x = x.astype(cfg.dtype)
+        x = gpipe(
+            self._stage_fn, params["stages"], x, self.mesh,
+            self.num_microbatches, axis=self.pp_axis,
+        )
+        x32 = x.astype(jnp.float32)
+        mean = x32.mean(-1, keepdims=True)
+        var = x32.var(-1, keepdims=True)
+        x32 = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+        x32 = x32 * params["ln_f_scale"] + params["ln_f_bias"]
+        logits = x32.astype(cfg.dtype) @ params["wte"].astype(cfg.dtype).T
+        return logits.astype(jnp.float32)

@@ -42,6 +42,13 @@ class TransformerConfig:
     remat: bool = False
     # BERT extras
     type_vocab_size: int = 2
+    # Mixture-of-Experts: replace the dense MLP with MoEMLP in every
+    # `moe_every`-th block when num_experts > 0 (expert dim shards over the
+    # `ep` mesh axis via parallel/tp_rules.py).
+    moe_num_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
 
 def _use_ring(cfg: TransformerConfig) -> bool:
@@ -95,17 +102,28 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
-    """Pre-norm transformer block."""
+    """Pre-norm transformer block (dense or MoE MLP)."""
 
     cfg: TransformerConfig
+    use_moe: bool = False
 
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
         ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)  # noqa: E731
         x = x + SelfAttention(cfg, name="attn")(ln("ln1")(x).astype(cfg.dtype))
-        x = x + MLP(cfg, name="mlp")(ln("ln2")(x).astype(cfg.dtype))
-        return x
+        if self.use_moe:
+            from ..parallel.moe import MoEMLP
+
+            mlp_out = MoEMLP(
+                d_model=cfg.d_model, d_ff=cfg.d_ff,
+                num_experts=cfg.moe_num_experts, k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor, dtype=cfg.dtype,
+                name="moe",
+            )(ln("ln2")(x).astype(cfg.dtype))
+        else:
+            mlp_out = MLP(cfg, name="mlp")(ln("ln2")(x).astype(cfg.dtype))
+        return x + mlp_out
 
 
 class TransformerLM(nn.Module):
@@ -128,7 +146,10 @@ class TransformerLM(nn.Module):
         if cfg.remat:
             block = nn.remat(Block, prevent_cse=False)
         for i in range(cfg.num_layers):
-            x = block(cfg, name=f"block_{i}")(x)
+            use_moe = (
+                cfg.moe_num_experts > 0 and (i + 1) % cfg.moe_every == 0
+            )
+            x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Weight-tied readout keeps the big vocab matmul on the MXU in bf16.
         logits = emb.attend(x.astype(cfg.dtype))

@@ -1,0 +1,119 @@
+"""Mixture-of-Experts with expert parallelism over the `ep` mesh axis.
+
+Completes the parallelism matrix (SURVEY.md §2.9: EP absent from the
+reference; first-class here).  Design:
+
+  - Top-k gating with capacity factor (Switch/GShard style): each token picks
+    its top-k experts; per-expert capacity C = k·T·cf/E bounds the dense
+    dispatch so every shape is static (XLA-friendly — no dynamic gathers).
+  - Dispatch/combine are einsums against a one-hot dispatch mask — the GShard
+    recipe: dense [T, E, C] masks keep the MXU busy and let the SPMD
+    partitioner turn the expert dimension into an all-to-all over ICI when
+    `ep` is in the mesh.
+  - Experts are a stacked FFN [E, d_model, d_ff]; sharding rules place the
+    E dimension on `ep` (combined_spec rule below), tokens stay on dp/sp.
+  - Load-balancing auxiliary loss (Switch §2.2) returned alongside.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def top_k_gating(
+    logits: jax.Array, k: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Compute dispatch/combine tensors.
+
+    logits: [tokens, experts].  Returns (dispatch [T,E,C] bool-ish float,
+    combine [T,E,C] float, aux_loss scalar).
+    """
+    t, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    # aux load-balance loss: E * sum_e (fraction_tokens_e * mean_prob_e)
+    top1 = jnp.argmax(probs, axis=-1)
+    frac_tokens = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    mean_probs = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(frac_tokens * mean_probs)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    # Track how many tokens each expert has accepted so far; droppable
+    # (over-capacity) tokens simply get no slot (GShard behavior).
+    remaining = probs
+    fill = jnp.zeros((e,), jnp.int32)
+    for _ in range(k):
+        choice = jnp.argmax(remaining, axis=-1)  # [T]
+        gate = jnp.take_along_axis(remaining, choice[:, None], axis=-1)[:, 0]
+        remaining = remaining * (1.0 - jax.nn.one_hot(choice, e, dtype=remaining.dtype))
+        # position of each token within its chosen expert's queue
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.int32)  # [T, E]
+        pos_within = (jnp.cumsum(onehot, axis=0) - 1) * onehot  # [T, E]
+        pos = jnp.sum(pos_within, axis=-1) + jnp.take(fill, choice)  # [T]
+        fill = fill + jnp.sum(onehot, axis=0)
+        keep = pos < capacity
+        pos = jnp.clip(pos, 0, capacity - 1)
+        slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)  # [T, C]
+        mask = (keep.astype(jnp.float32) * 1.0)[:, None, None]
+        contrib = onehot.astype(jnp.float32)[:, :, None] * slot[:, None, :] * mask
+        dispatch = dispatch + contrib
+        combine = combine + contrib * gate[:, None, None]
+    return dispatch, combine, aux_loss
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the transformer MLP block."""
+
+    d_model: int
+    d_ff: int
+    num_experts: int = 8
+    k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        tokens = x.reshape(b * t, d)
+        n_tok = b * t
+        capacity = max(1, int(self.k * n_tok * self.capacity_factor / self.num_experts))
+
+        gate_logits = nn.Dense(self.num_experts, dtype=jnp.float32,
+                               name="router")(tokens.astype(jnp.float32))
+        dispatch, combine, aux_loss = top_k_gating(gate_logits, self.k, capacity)
+        self.sow("intermediates", "moe_aux_loss", aux_loss)
+
+        # [E, C, d] expert inputs via dense dispatch einsum (MXU-friendly).
+        expert_in = jnp.einsum("td,tec->ecd", tokens.astype(self.dtype),
+                               dispatch.astype(self.dtype))
+        wi = self.param("wi", nn.initializers.normal(0.02),
+                        (self.num_experts, d, self.d_ff))
+        wo = self.param("wo", nn.initializers.normal(0.02),
+                        (self.num_experts, self.d_ff, d))
+        h = jnp.einsum("ecd,edf->ecf", expert_in, wi.astype(self.dtype))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
+        out = jnp.einsum("ecd,tec->td", expert_out, combine.astype(self.dtype))
+        return out.reshape(b, t, d).astype(x.dtype)
+
+
+def moe_aux_loss(intermediates) -> jax.Array:
+    """Sum the sown per-layer aux losses from model.apply(..., mutable=['intermediates'])."""
+    losses = []
+
+    def visit(node):
+        if isinstance(node, dict):
+            for key, value in node.items():
+                if key == "moe_aux_loss":
+                    losses.extend(value if isinstance(value, (list, tuple)) else [value])
+                else:
+                    visit(value)
+
+    visit(intermediates)
+    if not losses:
+        return jnp.zeros(())
+    return sum(losses) / len(losses)

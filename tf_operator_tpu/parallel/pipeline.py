@@ -1,0 +1,88 @@
+"""Pipeline parallelism: SPMD GPipe over the `pp` mesh axis.
+
+Completes the parallelism matrix (SURVEY.md §2.9: PP absent from the
+reference).  Collective-based GPipe, not per-device programs: every device
+runs the same jitted program; stage s of the model lives on pp-rank s
+(stage-stacked params sharded on their leading dim), and activations hop one
+ICI neighbor per step via `ppermute`.  With M microbatches and P stages the
+schedule takes M+P-1 steps (bubble fraction (P-1)/(M+P-1)); all shapes are
+static and the whole schedule is a single `lax.fori_loop` under `shard_map`
+— XLA sees one compiled program per device, compiler-friendly by
+construction.
+
+stage_fn must be shape-preserving (activation in == activation out), which
+transformer blocks are; embedding/head run outside the pipelined region.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .ring_attention import shard_map
+
+
+def gpipe(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+) -> jax.Array:
+    """Run `stage_fn` as a P-stage pipeline.
+
+    stacked_params: pytree whose leaves have leading dim P (one slice per
+    stage), sharded over `axis`.  x: [batch, ...] activations entering stage
+    0.  Returns activations leaving stage P-1, same shape as x.
+    """
+    num_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(f"batch {batch} not divisible by microbatches {num_microbatches}")
+    x_mb = x.reshape(num_microbatches, batch // num_microbatches, *x.shape[1:])
+
+    def local(params, x_mb):
+        rank = lax.axis_index(axis)
+        num_mb = x_mb.shape[0]
+        params = jax.tree_util.tree_map(lambda p: p[0], params)  # squeeze stage dim
+        out = jnp.zeros_like(x_mb)
+        carry_in = jnp.zeros_like(x_mb[0])
+        perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+        def step(s, state):
+            carry_in, out = state
+            feed = lax.dynamic_index_in_dim(
+                x_mb, jnp.clip(s, 0, num_mb - 1), axis=0, keepdims=False
+            )
+            inp = jnp.where(rank == 0, feed, carry_in)
+            act = stage_fn(params, inp)
+            valid = jnp.logical_and(s - rank >= 0, s - rank < num_mb)
+            act = jnp.where(valid, act, jnp.zeros_like(act))
+            write_idx = jnp.clip(s - (num_stages - 1), 0, num_mb - 1)
+            current = lax.dynamic_index_in_dim(out, write_idx, axis=0, keepdims=False)
+            is_writer = jnp.logical_and(valid, rank == num_stages - 1)
+            new_row = jnp.where(is_writer, act, current)
+            out = lax.dynamic_update_index_in_dim(out, new_row, write_idx, axis=0)
+            carry_next = lax.ppermute(act, axis, perm)
+            return carry_next, out
+
+        _, out = lax.fori_loop(0, num_mb + num_stages - 1, step, (carry_in, out))
+        return lax.psum(out, axis)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params
+    )
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_rep=False,
+    )
+    out_mb = fn(stacked_params, x_mb)
+    return out_mb.reshape(batch, *x.shape[1:])

@@ -39,6 +39,12 @@ _TP_RULES: Tuple[Tuple[str, dict], ...] = (
     (r"(wte|tok_emb)/embedding$", {"shard_dim": 0}),
 )
 
+# MoE expert weights [E, d_in, d_out]: expert dim shards over `ep`.
+_EP_RULES: Tuple[Tuple[str, int], ...] = (
+    (r"moe/wi$", 0),
+    (r"moe/wo$", 0),
+)
+
 
 def tp_spec_for_path(path: str, ndim: int, mesh: Mesh) -> Optional[P]:
     """The tensor-parallel PartitionSpec for a param path, or None if no
@@ -56,10 +62,26 @@ def tp_spec_for_path(path: str, ndim: int, mesh: Mesh) -> Optional[P]:
     return None
 
 
+def ep_spec_for_path(path: str, ndim: int, mesh: Mesh) -> Optional[P]:
+    from .mesh import AXIS_EP
+
+    if axis_size(mesh, AXIS_EP) <= 1:
+        return None
+    for pattern, dim in _EP_RULES:
+        if re.search(pattern, path):
+            spec = [None] * ndim
+            if dim < ndim:
+                spec[dim] = AXIS_EP
+            return P(*spec)
+    return None
+
+
 def combined_spec(path: str, shape, mesh: Mesh) -> P:
-    """TP rule first; then FSDP-shard the largest remaining divisible dim."""
+    """EP/TP rule first; then FSDP-shard the largest remaining divisible dim."""
     ndim = len(shape)
-    spec = tp_spec_for_path(path, ndim, mesh)
+    spec = ep_spec_for_path(path, ndim, mesh)
+    if spec is None:
+        spec = tp_spec_for_path(path, ndim, mesh)
     parts = list(spec) if spec is not None else [None] * ndim
     while len(parts) < ndim:
         parts.append(None)

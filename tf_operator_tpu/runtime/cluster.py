@@ -177,9 +177,36 @@ class InMemoryCluster(ClusterInterface):
                 raise AlreadyExists(f"pod {key} already exists")
             self._assign_uid(pod.metadata, "pod")
             self._pods[key] = pod
+        if self._requires_gang_binding(pod):
+            # Deferred binding: the gang scheduler admits the whole group
+            # atomically via bind_pod (runtime/scheduler.py).
+            self._dispatch(self._pod_handlers, EventType.ADDED, pod)
+            return pod
+        pod.metadata.annotations["tpu-operator.dev/bound"] = "true"
         self._started_pod(pod)
         self._dispatch(self._pod_handlers, EventType.ADDED, pod)
         return pod
+
+    def _requires_gang_binding(self, pod: Pod) -> bool:
+        # Any scheduler name + gang-group annotation means a gang scheduler
+        # owns admission (the name is configurable via --gang-scheduler-name,
+        # so matching a fixed constant here would silently bypass holding).
+        from ..api import constants
+
+        return bool(
+            pod.spec.scheduler_name
+            and pod.metadata.annotations.get(constants.GANG_GROUP_ANNOTATION)
+        )
+
+    def bind_pod(self, namespace: str, name: str) -> None:
+        """Admit a gang-held pod: mark bound and start it."""
+        with self._lock:
+            pod = self.get_pod(namespace, name)
+            if pod.metadata.annotations.get("tpu-operator.dev/bound") == "true":
+                return
+            pod.metadata.annotations["tpu-operator.dev/bound"] = "true"
+        self._started_pod(pod)
+        self._dispatch(self._pod_handlers, EventType.MODIFIED, pod)
 
     def _started_pod(self, pod: Pod) -> None:
         """Hook for subclasses that actually run pods (LocalProcessCluster)."""

@@ -1,0 +1,187 @@
+"""Gang scheduler: all-or-nothing admission of TPU slice gangs.
+
+The reference delegates gang semantics to Volcano (PodGroup MinMember,
+vendor/.../common/job_controller.go:211-239) and trusts the cluster to
+enforce them.  Our substrates are the cluster, so this module enforces them:
+pods stamped with the gang scheduler name are held unbound (Pending) until
+
+  1. the whole gang is present (count >= PodGroup.min_member), and
+  2. the slice pool has capacity for the gang's total chip request
+
+— then every member binds atomically.  A partial TPU slice is useless, so
+admission is all-or-nothing by construction; capacity is released when gang
+pods are deleted.
+
+The pool models the driver-visible fabric (e.g. one v5e-32 = 32 chips).
+`google.com/tpu` container requests (injected by defaults from the replica's
+topology block) are the unit of accounting.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Set
+
+from ..api import constants
+from ..api.core import Pod
+from ..utils import logging as tpulog
+from .cluster import ClusterInterface, EventType, NotFound
+
+log = tpulog.logger_for_key("gang-scheduler")
+
+
+def pod_chip_request(pod: Pod) -> float:
+    total = 0.0
+    for container in pod.spec.containers:
+        total += float(container.resources.get(constants.TPU_RESOURCE, 0.0))
+    return total
+
+
+class SlicePool:
+    """Chip-capacity accounting. capacity None = unlimited."""
+
+    def __init__(self, total_chips: Optional[float] = None) -> None:
+        self.total = total_chips
+        self.used = 0.0
+        self._lock = threading.Lock()
+
+    def try_reserve(self, chips: float) -> bool:
+        with self._lock:
+            if self.total is not None and self.used + chips > self.total:
+                return False
+            self.used += chips
+            return True
+
+    def release(self, chips: float) -> None:
+        with self._lock:
+            self.used = max(0.0, self.used - chips)
+
+
+class GangScheduler:
+    """Watches pods; binds complete gangs atomically.
+
+    The substrate must support deferred binding: pods whose
+    `spec.scheduler_name` equals the gang scheduler name are created Pending
+    and only start when `cluster.bind_pod(ns, name)` is called
+    (InMemoryCluster implements this)."""
+
+    def __init__(self, cluster: ClusterInterface,
+                 total_chips: Optional[float] = None,
+                 scheduler_name: str = constants.GANG_SCHEDULER_NAME) -> None:
+        self.cluster = cluster
+        self.pool = SlicePool(total_chips)
+        self.scheduler_name = scheduler_name
+        self._lock = threading.Lock()
+        # group key -> reserved chips (admitted gangs)
+        self._admitted: Dict[str, float] = {}
+        # group key -> member pod names currently existing
+        self._members: Dict[str, Set[str]] = {}
+        cluster.watch_pods(self._on_pod_event)
+
+    @staticmethod
+    def _group_key(pod: Pod) -> Optional[str]:
+        group = pod.metadata.annotations.get(constants.GANG_GROUP_ANNOTATION)
+        if not group:
+            return None
+        return f"{pod.metadata.namespace}/{group}"
+
+    def _on_pod_event(self, etype: EventType, pod: Pod) -> None:
+        if pod.spec.scheduler_name != self.scheduler_name:
+            return
+        key = self._group_key(pod)
+        if key is None:
+            return
+        if etype == EventType.ADDED:
+            with self._lock:
+                self._members.setdefault(key, set()).add(pod.metadata.name)
+            self._try_admit(key, pod.metadata.namespace)
+        elif etype == EventType.DELETED:
+            self._handle_departure(key, pod)
+        elif etype == EventType.MODIFIED:
+            # A terminal pod holds no chips: treat Succeeded/Failed members
+            # as departed so completed gangs free the slice.
+            from ..api.core import PodPhase
+
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                self._handle_departure(key, pod)
+
+    def _handle_departure(self, key: str, pod: Pod) -> None:
+        with self._lock:
+            members = self._members.get(key)
+            if members is not None:
+                members.discard(pod.metadata.name)
+                if not members:
+                    # Gang fully gone: release its reservation.
+                    chips = self._admitted.pop(key, None)
+                    self._members.pop(key, None)
+                    if chips:
+                        self.pool.release(chips)
+                        log.info("released %.0f chips from gang %s", chips, key)
+        # Capacity may have freed: retry other waiting gangs.
+        self._retry_waiting()
+
+    def _try_admit(self, key: str, namespace: str) -> None:
+        group_name = key.split("/", 1)[1]
+        try:
+            podgroup = self.cluster.get_podgroup(namespace, group_name)
+        except NotFound:
+            return  # controller hasn't synced the PodGroup yet; retried on next event
+        from ..api.core import PodPhase
+
+        pods = [
+            p for p in self.cluster.list_pods(namespace)
+            if self._group_key(p) == key
+            and p.status.phase not in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+        ]
+        unbound = [p for p in pods if not self._is_bound(p)]
+        # Atomic check-admit section: the already-admitted check, the chip
+        # reservation, and the admitted record must not interleave with a
+        # concurrent _try_admit for the same gang (double-reserve would leak
+        # pool capacity permanently).
+        with self._lock:
+            if key in self._admitted:
+                admit_late_only = True
+            else:
+                admit_late_only = False
+                if len(pods) < podgroup.min_member:
+                    return
+                chips = sum(pod_chip_request(p) for p in pods)
+                if not self.pool.try_reserve(chips):
+                    log.info(
+                        "gang %s waiting: %.0f chips requested, %.0f/%s in use",
+                        key, chips, self.pool.used, self.pool.total,
+                    )
+                    podgroup.phase = "Pending"
+                    return
+                self._admitted[key] = chips
+        if admit_late_only:
+            # Late members of an admitted gang (e.g. a restarted pod) bind
+            # immediately — the reservation is gang-lifetime.
+            for pod in unbound:
+                self._bind(pod)
+            return
+        podgroup.phase = "Running"
+        log.info("admitting gang %s (%d pods, %.0f chips)", key, len(pods), chips)
+        for pod in unbound:
+            self._bind(pod)
+
+    @staticmethod
+    def _is_bound(pod: Pod) -> bool:
+        return pod.metadata.annotations.get("tpu-operator.dev/bound") == "true"
+
+    def _bind(self, pod: Pod) -> None:
+        binder = getattr(self.cluster, "bind_pod", None)
+        if binder is not None:
+            binder(pod.metadata.namespace, pod.metadata.name)
+
+    def _retry_waiting(self) -> None:
+        namespaces = {}
+        for pod in self.cluster.list_pods():
+            key = self._group_key(pod)
+            if key is None or pod.spec.scheduler_name != self.scheduler_name:
+                continue
+            with self._lock:
+                if key in self._admitted:
+                    continue
+            namespaces[key] = pod.metadata.namespace
+        for key, namespace in namespaces.items():
+            self._try_admit(key, namespace)

@@ -1,0 +1,47 @@
+"""Reference-SDK compatibility surface.
+
+Users of the reference import `from kubeflow.tfjob import TFJobClient`
+(/root/reference/sdk/python/kubeflow/tfjob/api/tf_job_client.py) with
+methods create/get/patch/delete/wait_for_job/wait_for_condition/
+get_job_status/is_job_running/is_job_succeeded/get_pod_names/get_logs.
+TPUJobClient already exposes that exact method surface; this module provides
+the familiar name plus the reference's constants
+(ref: sdk/python/kubeflow/tfjob/constants/constants.py:18-33) mapped to this
+framework's values, and a `log_status` watch callback matching the
+reference's table logger (tf_job_watch.py:29-59).
+"""
+from __future__ import annotations
+
+import time
+
+from ..api import constants as _api_constants
+from .client import TPUJobClient
+
+# Reference constants surface (constants.py:18-33), TPU values.
+TFJOB_GROUP = _api_constants.API_GROUP
+TFJOB_VERSION = _api_constants.API_VERSION
+TFJOB_KIND = _api_constants.KIND
+TFJOB_PLURAL = _api_constants.PLURAL
+TFJOB_LOGLEVEL = "INFO"
+
+JOB_GROUP_LABEL = _api_constants.LABEL_GROUP_NAME
+JOB_NAME_LABEL = _api_constants.LABEL_JOB_NAME
+JOB_TYPE_LABEL = _api_constants.LABEL_REPLICA_TYPE
+JOB_INDEX_LABEL = _api_constants.LABEL_REPLICA_INDEX
+JOB_ROLE_LABEL = _api_constants.LABEL_JOB_ROLE
+
+
+class TFJobClient(TPUJobClient):
+    """Drop-in alias: the TFJobClient method surface over any cluster backend."""
+
+
+def log_status(job) -> None:
+    """Watch callback printing the reference's status table
+    (NAME / STATE / TIME)."""
+    state = ""
+    for cond in reversed(job.status.conditions):
+        if cond.status:
+            state = cond.type.value
+            break
+    print(f"{job.metadata.name:<30} {state or 'Created':<20} "
+          f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}")

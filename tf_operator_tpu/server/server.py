@@ -46,6 +46,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         action="store_false")
     parser.add_argument("--enable-gang-scheduling", action="store_true")
     parser.add_argument("--gang-scheduler-name", default="tpu-gang")
+    parser.add_argument("--slice-chips", type=float, default=None,
+                        help="total TPU chips the gang scheduler may admit "
+                             "(default unlimited)")
     parser.add_argument("--monitoring-port", type=int, default=8443)
     parser.add_argument("--api-port", type=int, default=8008,
                         help="REST API port; 0 disables")
@@ -164,6 +167,30 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
         threadiness=args.threadiness,
         **({"resolver": resolver_owner.resolver} if resolver_owner else {}),
     )
+    if args.enable_gang_scheduling:
+        from ..runtime.scheduler import GangScheduler
+
+        controller.gang_scheduler = GangScheduler(
+            cluster, total_chips=args.slice_chips,
+            scheduler_name=args.gang_scheduler_name,
+        )
+
+    # SIGTERM/SIGINT: first one stops gracefully, second exits 1
+    # (ref: vendor/.../util/signals/signal.go:25-42).
+    if threading.current_thread() is threading.main_thread():
+        import os
+        import signal as signal_mod
+
+        signal_count = {"n": 0}
+
+        def _handle_signal(signum, frame):
+            signal_count["n"] += 1
+            if signal_count["n"] >= 2:
+                os._exit(1)
+            controller.stop()
+
+        signal_mod.signal(signal_mod.SIGTERM, _handle_signal)
+        signal_mod.signal(signal_mod.SIGINT, _handle_signal)
 
     monitoring = start_monitoring(args.monitoring_port)
     log.info("monitoring on 127.0.0.1:%d (/metrics /healthz /debug/threads)",

@@ -1,0 +1,153 @@
+"""ctypes bindings for the native (C++) prefetching data loader.
+
+Builds native/dataloader.cpp on first use (g++, cached as
+native/libtpujob_data.so) and exposes iterators matching train/data.py's
+shapes.  Falls back cleanly: callers should use `native_available()` or the
+`*_or_fallback` helpers — the Python generators remain the reference
+implementation.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "dataloader.cpp"))
+_LIB = os.path.abspath(os.path.join(_NATIVE_DIR, "libtpujob_data.so"))
+
+_KIND_IMAGES = 0
+_KIND_MNIST = 1
+_KIND_TOKENS = 2
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        ):
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC, "-lpthread"],
+                    check=True, capture_output=True, timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                _build_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _build_failed = True
+            return None
+        lib.dl_create.restype = ctypes.c_void_p
+        lib.dl_create.argtypes = [ctypes.c_int] * 5 + [
+            ctypes.c_uint32, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.dl_next.restype = ctypes.c_int
+        lib.dl_next.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        lib.dl_x_size.restype = ctypes.c_int64
+        lib.dl_x_size.argtypes = [ctypes.c_void_p]
+        lib.dl_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class _NativeIterator:
+    def __init__(self, kind: int, batch: int, dim1: int, num_classes: int,
+                 seed: int, x_shape, has_labels: bool, key_x: str,
+                 prefetch_depth: int = 4, num_threads: int = 2) -> None:
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native dataloader unavailable")
+        self._lib = lib
+        self._handle = lib.dl_create(
+            kind, batch, dim1, 0, num_classes, seed & 0xFFFFFFFF,
+            prefetch_depth, num_threads,
+        )
+        self._batch = batch
+        self._x_shape = x_shape
+        self._has_labels = has_labels
+        self._key_x = key_x
+        self._x_buf = np.empty(int(lib.dl_x_size(self._handle)), np.float32)
+        self._y_buf = np.empty(batch, np.int32)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        rc = self._lib.dl_next(
+            self._handle,
+            self._x_buf.ctypes.data_as(ctypes.c_void_p),
+            self._y_buf.ctypes.data_as(ctypes.c_void_p) if self._has_labels else None,
+        )
+        if rc != 0:
+            raise StopIteration
+        x = self._x_buf.reshape(self._x_shape).copy()
+        if self._key_x == "tokens":
+            return {"tokens": x.astype(np.int32)}
+        out = {self._key_x: x}
+        if self._has_labels:
+            out["label"] = self._y_buf.copy()
+        return out
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.dl_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def native_synthetic_images(batch_size: int, image_size: int = 224,
+                            num_classes: int = 1000, seed: int = 0,
+                            num_threads: int = 4) -> _NativeIterator:
+    return _NativeIterator(
+        _KIND_IMAGES, batch_size, image_size, num_classes, seed,
+        (batch_size, image_size, image_size, 3), True, "x",
+        num_threads=num_threads,
+    )
+
+
+def native_synthetic_mnist(batch_size: int, seed: int = 0) -> _NativeIterator:
+    return _NativeIterator(
+        _KIND_MNIST, batch_size, 28, 10, seed, (batch_size, 784), True, "x"
+    )
+
+
+def native_synthetic_tokens(batch_size: int, seq_len: int,
+                            vocab_size: int = 32000, seed: int = 0) -> _NativeIterator:
+    return _NativeIterator(
+        _KIND_TOKENS, batch_size, seq_len, vocab_size, seed,
+        (batch_size, seq_len), False, "tokens"
+    )
+
+
+def images_or_fallback(batch_size: int, image_size: int = 224,
+                       num_classes: int = 1000, seed: int = 0) -> Iterator:
+    if native_available():
+        return native_synthetic_images(batch_size, image_size, num_classes, seed)
+    from .data import synthetic_images
+
+    return synthetic_images(batch_size, image_size, num_classes, seed)

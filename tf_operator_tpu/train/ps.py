@@ -1,0 +1,178 @@
+"""Asynchronous parameter-server runtime (CPU-side, socket transport).
+
+The reference's PS strategy is delivered by TF's gRPC runtime inside user
+containers (SURVEY.md §2.9 — the operator only wires addresses).  This
+framework owns the training runtime, so it ships a real PS implementation:
+parameter shards live on PS processes; workers pull, compute grads locally
+(JAX), and push asynchronously (Hogwild-style downpour SGD).
+
+Honest TPU note: async PS is a CPU/heterogeneous-cluster pattern — on a TPU
+slice, synchronous allreduce over ICI dominates it and is the default path
+(train/step.py).  This module exists for capability parity with reference
+dist-mnist jobs (examples/v1/dist-mnist/dist_mnist.py:98-143) and for
+CPU-parameter-server topologies.
+
+Protocol: length-prefixed pickled tuples over TCP.
+  ("pull",)              -> {name: np.ndarray}  (this shard's params)
+  ("push", {name: grad}) -> ("ok", version)     (applies SGD update)
+  ("shutdown",)          -> ("ok",)
+Param leaves are assigned to PS replicas round-robin by sorted name.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_LEN = struct.Struct("!Q")
+
+
+def _send(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv(sock: socket.socket):
+    header = _recv_exact(sock, _LEN.size)
+    (length,) = _LEN.unpack(header)
+    return pickle.loads(_recv_exact(sock, length))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def shard_names(all_names: List[str], num_ps: int, ps_index: int) -> List[str]:
+    """Round-robin leaf assignment (deterministic on sorted names)."""
+    return [n for i, n in enumerate(sorted(all_names)) if i % num_ps == ps_index]
+
+
+class ParameterServer(socketserver.ThreadingTCPServer):
+    """Holds one shard; applies pushed grads with plain SGD (downpour)."""
+
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, address: Tuple[str, int], params: Dict[str, np.ndarray],
+                 lr: float = 0.1) -> None:
+        self.params = {k: np.asarray(v, np.float32).copy() for k, v in params.items()}
+        self.lr = lr
+        self.version = 0
+        self.lock = threading.Lock()
+        self._shutdown_requested = threading.Event()
+        super().__init__(address, _PSHandler)
+
+    def serve_until_shutdown(self) -> None:
+        thread = threading.Thread(target=self.serve_forever, daemon=True)
+        thread.start()
+        self._shutdown_requested.wait()
+        self.shutdown()
+
+
+class _PSHandler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        server: ParameterServer = self.server  # type: ignore[assignment]
+        try:
+            while True:
+                msg = _recv(self.request)
+                op = msg[0]
+                if op == "pull":
+                    with server.lock:
+                        _send(self.request, (dict(server.params), server.version))
+                elif op == "push":
+                    grads = msg[1]
+                    with server.lock:
+                        for name, grad in grads.items():
+                            if name in server.params:
+                                server.params[name] -= server.lr * np.asarray(grad)
+                        server.version += 1
+                        _send(self.request, ("ok", server.version))
+                elif op == "shutdown":
+                    _send(self.request, ("ok",))
+                    server._shutdown_requested.set()
+                    return
+                else:
+                    _send(self.request, ("err", f"unknown op {op!r}"))
+        except (ConnectionError, EOFError):
+            return
+
+
+class PSClient:
+    """Worker-side view over all PS shards."""
+
+    def __init__(self, addresses: List[str], timeout: float = 30.0) -> None:
+        self.addresses = addresses
+        self._socks: List[Optional[socket.socket]] = [None] * len(addresses)
+        self.timeout = timeout
+
+    def _sock(self, i: int) -> socket.socket:
+        if self._socks[i] is None:
+            host, _, port = self.addresses[i].rpartition(":")
+            sock = socket.create_connection((host, int(port)), timeout=self.timeout)
+            self._socks[i] = sock
+        return self._socks[i]
+
+    def pull(self) -> Dict[str, np.ndarray]:
+        merged: Dict[str, np.ndarray] = {}
+        for i in range(len(self.addresses)):
+            _send(self._sock(i), ("pull",))
+            shard, _version = _recv(self._sock(i))
+            merged.update(shard)
+        return merged
+
+    def push(self, grads: Dict[str, np.ndarray], num_ps: Optional[int] = None) -> None:
+        num_ps = num_ps or len(self.addresses)
+        names = sorted(grads)
+        for i in range(len(self.addresses)):
+            mine = {n: grads[n] for n in shard_names(names, num_ps, i)}
+            if not mine:
+                continue
+            _send(self._sock(i), ("push", mine))
+            _recv(self._sock(i))
+
+    def shutdown_servers(self) -> None:
+        for i in range(len(self.addresses)):
+            try:
+                _send(self._sock(i), ("shutdown",))
+                _recv(self._sock(i))
+            except (OSError, ConnectionError):
+                pass
+
+    def close(self) -> None:
+        for sock in self._socks:
+            if sock is not None:
+                sock.close()
+        self._socks = [None] * len(self.addresses)
+
+
+def flatten_params(params, prefix="") -> Dict[str, np.ndarray]:
+    out = {}
+    for key, value in params.items():
+        path = f"{prefix}/{key}" if prefix else str(key)
+        if isinstance(value, dict):
+            out.update(flatten_params(value, path))
+        else:
+            out[path] = np.asarray(value, np.float32)
+    return out
+
+
+def unflatten_params(flat: Dict[str, np.ndarray]):
+    tree: Dict = {}
+    for path, value in flat.items():
+        parts = path.split("/")
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return tree

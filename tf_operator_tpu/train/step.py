@@ -28,11 +28,25 @@ def softmax_cross_entropy(logits, labels) -> jax.Array:
     return -jnp.mean(ll)
 
 
-def lm_loss_fn(apply_fn):
-    """Next-token prediction loss for TransformerLM."""
+def lm_loss_fn(apply_fn, moe_aux_weight: float = 0.0):
+    """Next-token prediction loss for TransformerLM.
+
+    With moe_aux_weight > 0, the Switch-style load-balancing losses sown by
+    MoE blocks (parallel/moe.py) are collected via the intermediates
+    collection and added to the objective — without this the router gets no
+    balancing gradient and experts collapse."""
 
     def loss(params, batch, rngs=None):
         tokens = batch["tokens"]
+        if moe_aux_weight > 0.0:
+            from ..parallel.moe import moe_aux_loss
+
+            logits, state = apply_fn(
+                {"params": params}, tokens[:, :-1], mutable=["intermediates"]
+            )
+            aux = moe_aux_loss(state["intermediates"])
+            ce = softmax_cross_entropy(logits, tokens[:, 1:])
+            return ce + moe_aux_weight * aux, {"moe_aux_loss": aux}
         logits = apply_fn({"params": params}, tokens[:, :-1])
         return softmax_cross_entropy(logits, tokens[:, 1:]), {}
 
@@ -79,6 +93,8 @@ def make_train_step(loss_fn, has_batch_stats: bool = False, donate: bool = True)
         (loss, aux), grads = jax.value_and_grad(compute, has_aux=True)(state.params)
         new_state = state.apply_gradients(grads, aux.get("batch_stats"))
         metrics = {"loss": loss}
+        if "moe_aux_loss" in aux:
+            metrics["moe_aux_loss"] = aux["moe_aux_loss"]
         return new_state, metrics
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())

@@ -1,0 +1,119 @@
+"""Distributed MNIST with a real async parameter server (BASELINE config 2).
+
+The JAX-native rebuild of the reference's dist-mnist example
+(examples/v1/dist-mnist/dist_mnist.py:98-143): PS replicas serve parameter
+shards (train/ps.py); workers read TF_CONFIG for the PS addresses, pull
+params, compute local grads with JAX, and push asynchronously.  Worker 0's
+clean exit marks the job Succeeded (the worker-0 rule); PS replicas park
+until CleanPodPolicy reaps them.
+
+Usage: python -m tf_operator_tpu.workloads.dist_mnist --steps 100
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--target-loss", type=float, default=None)
+    args = parser.parse_args(argv)
+
+    forced = os.environ.get("TPUJOB_FORCE_PLATFORM")
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
+
+    from .runner import WorkloadContext
+
+    ctx = WorkloadContext.from_env()
+    print(f"dist-mnist: role={ctx.replica_type} index={ctx.replica_index}",
+          flush=True)
+
+    if ctx.tf_config is None:
+        print("dist_mnist requires a distributed TF_CONFIG topology", flush=True)
+        return 2
+    cluster = ctx.tf_config.get("cluster") or ctx.tf_config.get("sparseCluster") or {}
+    ps_addresses = list(cluster.get("ps", []))
+    if not ps_addresses:
+        print("no PS replicas in cluster spec", flush=True)
+        return 2
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.mnist import MnistMLP
+    from ..train import ps as ps_lib
+    from ..train.data import synthetic_mnist
+
+    model = MnistMLP()
+    init_params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 784)))["params"]
+    flat_init = ps_lib.flatten_params(init_params)
+
+    if ctx.replica_type == "ps":
+        # Serve this shard until a worker sends shutdown (or we are reaped).
+        my_names = ps_lib.shard_names(
+            sorted(flat_init), len(ps_addresses), ctx.replica_index
+        )
+        shard = {n: flat_init[n] for n in my_names}
+        _, _, port = ps_addresses[ctx.replica_index].rpartition(":")
+        server = ps_lib.ParameterServer(("0.0.0.0", int(port)), shard, lr=args.lr)
+        print(f"ps {ctx.replica_index} serving {len(shard)} leaves on :{port}",
+              flush=True)
+        server.serve_until_shutdown()
+        print("ps shutdown", flush=True)
+        return 0
+
+    # --- worker ---
+    client = ps_lib.PSClient(ps_addresses)
+    # PS processes may come up after us; retry the first pull.
+    for attempt in range(60):
+        try:
+            flat = client.pull()
+            break
+        except (OSError, ConnectionError):
+            client.close()
+            time.sleep(1.0)
+    else:
+        print("could not reach parameter servers", flush=True)
+        return 1
+
+    @jax.jit
+    def grad_fn(params, x, y):
+        def loss_fn(p):
+            logits = model.apply({"params": p}, x)
+            logp = jax.nn.log_softmax(logits, -1)
+            return -jnp.mean(jnp.take_along_axis(logp, y[:, None], -1))
+
+        return jax.value_and_grad(loss_fn)(params)
+
+    data = synthetic_mnist(args.batch, seed=100 + ctx.replica_index)
+    loss = float("inf")
+    for step_idx in range(args.steps):
+        batch = next(data)
+        params = ps_lib.unflatten_params(client.pull())
+        loss_val, grads = grad_fn(
+            params, jnp.asarray(batch["x"]), jnp.asarray(batch["label"])
+        )
+        client.push(ps_lib.flatten_params(grads))
+        loss = float(loss_val)
+        if step_idx % 10 == 0:
+            print(f"worker {ctx.replica_index} step {step_idx} loss {loss:.4f}",
+                  flush=True)
+    print(f"worker {ctx.replica_index} final loss {loss:.4f}", flush=True)
+    client.close()
+    if args.target_loss is not None and loss > args.target_loss:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

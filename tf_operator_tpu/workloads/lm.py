@@ -27,6 +27,9 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpoint-dir", default=None)
     parser.add_argument("--checkpoint-every", type=int, default=20)
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--moe-experts", type=int, default=0,
+                        help="enable MoE with this many experts (ep-sharded)")
+    parser.add_argument("--moe-aux-weight", type=float, default=0.01)
     args = parser.parse_args(argv)
 
     forced = os.environ.get("TPUJOB_FORCE_PLATFORM")
@@ -62,6 +65,7 @@ def main(argv=None) -> int:
         num_heads=max(1, args.d_model // 64), d_model=args.d_model,
         d_ff=args.d_model * 4, max_len=args.seq_len,
         mesh=mesh, ring_axis="sp", remat=args.remat,
+        moe_num_experts=args.moe_experts,
     )
     model = TransformerLM(cfg)
     state = create_train_state(
@@ -79,7 +83,10 @@ def main(argv=None) -> int:
         if mgr.latest_step() is not None:
             print(f"resumed from step {int(state.step)}", flush=True)
 
-    step = make_train_step(lm_loss_fn(model.apply))
+    step = make_train_step(lm_loss_fn(
+        model.apply,
+        moe_aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
+    ))
     data = synthetic_tokens(args.batch, args.seq_len + 1, args.vocab)
     start = int(state.step)
     for i in range(start, args.steps):

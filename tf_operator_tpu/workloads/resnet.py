@@ -44,7 +44,7 @@ def main(argv=None) -> int:
     import optax
 
     from ..models import resnet as resnet_lib
-    from ..train.data import synthetic_images
+    from ..train.native_data import images_or_fallback
     from ..train.state import create_train_state
     from ..train.step import (
         classification_loss_fn,
@@ -67,7 +67,7 @@ def main(argv=None) -> int:
                                model_kwargs={"train": True}),
         has_batch_stats=True,
     )
-    data = synthetic_images(args.batch, args.image_size, args.num_classes)
+    data = images_or_fallback(args.batch, args.image_size, args.num_classes)
     t_start = time.time()
     for i in range(args.steps):
         batch = next(data)

@@ -1,0 +1,53 @@
+"""Generic smoke workload — the tf_smoke.py analogue.
+
+The reference's examples/tf_sample/tf_smoke.py runs a matmul on every
+cluster-spec member to prove the topology works.  Here: parse the injected
+topology, (optionally) join the jax.distributed group, run a jitted matmul
+on the local backend, print the device + result checksum, exit 0.
+
+Usage: python -m tf_operator_tpu.workloads.smoke [--size 1024]
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--size", type=int, default=1024)
+    args = parser.parse_args(argv)
+
+    forced = os.environ.get("TPUJOB_FORCE_PLATFORM")
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
+
+    from .runner import WorkloadContext
+
+    ctx = WorkloadContext.from_env()
+    print(f"smoke: role={ctx.replica_type} index={ctx.replica_index} "
+          f"tf_config={'yes' if ctx.tf_config else 'no'}", flush=True)
+    if ctx.replica_type == "ps":
+        # PS replicas only need to be addressable; nothing to compute.
+        print("smoke PS parked OK", flush=True)
+        return 0
+
+    import jax
+    import jax.numpy as jnp
+
+    ctx.initialize_distributed()
+    n = args.size
+    x = jnp.ones((n, n), jnp.bfloat16)
+    y = jax.jit(lambda a: a @ a)(x)
+    checksum = float(jnp.sum(y.astype(jnp.float32)))
+    expected = float(n) ** 3
+    print(f"smoke matmul on {jax.devices()[0]}: checksum={checksum:.3e} "
+          f"expected={expected:.3e}", flush=True)
+    return 0 if abs(checksum - expected) / expected < 1e-2 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

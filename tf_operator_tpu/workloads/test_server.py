@@ -30,6 +30,7 @@ def main(argv=None) -> int:
     parser.add_argument("--auto-exit-code", type=int, default=0)
     args = parser.parse_args(argv)
 
+    print(f"test-server {args.pod_name}: started", flush=True)
     os.makedirs(args.ctrl_dir, exist_ok=True)
     # /tfconfig analogue: publish the env view for test assertions.
     view = {
