@@ -1,0 +1,115 @@
+"""PodDisruptionBudget gang mechanism tests.
+
+The reference offers two gang mechanisms: Volcano PodGroup admission
+(SyncPodGroup, vendor/.../common/job_controller.go:211-239) and a
+PodDisruptionBudget guarding voluntary evictions (SyncPdb/DeletePdb,
+job_controller.go:242-316).  These cover the second: budget lifecycle tied
+to job state, eviction protection while the gang runs, and the default
+scheduler keeping ownership of pdb-mode pods.
+"""
+import pytest
+
+from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.runtime.cluster import (
+    EvictionBlocked,
+    InMemoryCluster,
+    NotFound,
+)
+from tf_operator_tpu.runtime.control import RealPodControl, RealServiceControl
+from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+
+from tf_operator_tpu.api.types import SchedulingPolicy
+
+from testutil import new_tpujob
+
+
+def pdb_stack():
+    from tf_operator_tpu.controller.controller import TPUJobController
+
+    cluster = InMemoryCluster()
+    controller = TPUJobController(
+        cluster,
+        config=ReconcilerConfig(enable_gang_scheduling=True, gang_mechanism="pdb"),
+    )
+    controller.reconciler.pod_control = RealPodControl(cluster)
+    controller.reconciler.service_control = RealServiceControl(cluster)
+    return controller, cluster
+
+
+class TestPdbLifecycle:
+    def test_sync_creates_pdb_with_total_replicas(self):
+        controller, cluster = pdb_stack()
+        job = new_tpujob(worker=3, ps=2)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+
+        pdb = cluster.get_pdb("default", job.metadata.name)
+        assert pdb.min_available == 5
+        assert pdb.selector["job-name"] == job.metadata.name
+        assert pdb.metadata.owner_name == job.metadata.name
+
+    def test_min_available_from_scheduling_policy(self):
+        controller, cluster = pdb_stack()
+        job = new_tpujob(worker=4)
+        job.spec.run_policy.scheduling_policy = SchedulingPolicy(min_available=2)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert cluster.get_pdb("default", job.metadata.name).min_available == 2
+
+    def test_pdb_mode_keeps_default_scheduler(self):
+        controller, cluster = pdb_stack()
+        job = new_tpujob(worker=2)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        for pod in cluster.list_pods(selector={"job-name": job.metadata.name}):
+            assert not pod.spec.scheduler_name
+
+    def test_terminal_job_deletes_pdb(self):
+        controller, cluster = pdb_stack()
+        job = new_tpujob(worker=2)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert cluster.get_pdb("default", job.metadata.name)
+
+        for pod in cluster.list_pods(selector={"job-name": job.metadata.name}):
+            cluster.set_pod_phase("default", pod.metadata.name, PodPhase.SUCCEEDED, exit_code=0)
+        controller.sync_job(job.key())  # detects success
+        controller.sync_job(job.key())  # terminal cleanup
+        with pytest.raises(NotFound):
+            cluster.get_pdb("default", job.metadata.name)
+
+
+class TestEvictionProtection:
+    def test_eviction_blocked_while_gang_running(self):
+        controller, cluster = pdb_stack()
+        job = new_tpujob(worker=2)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        pods = cluster.list_pods(selector={"job-name": job.metadata.name})
+        assert len(pods) == 2
+        with pytest.raises(EvictionBlocked):
+            cluster.evict_pod("default", pods[0].metadata.name)
+        # Direct deletes (involuntary failures) are never guarded.
+        cluster.delete_pod("default", pods[0].metadata.name)
+
+    def test_eviction_allowed_above_min_available(self):
+        controller, cluster = pdb_stack()
+        job = new_tpujob(worker=3)
+        job.spec.run_policy.scheduling_policy = SchedulingPolicy(min_available=1)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        pods = cluster.list_pods(selector={"job-name": job.metadata.name})
+        cluster.evict_pod("default", pods[0].metadata.name)
+        cluster.evict_pod("default", pods[1].metadata.name)
+        with pytest.raises(EvictionBlocked):
+            cluster.evict_pod("default", pods[2].metadata.name)
+
+    def test_terminal_pods_do_not_count_as_healthy(self):
+        controller, cluster = pdb_stack()
+        job = new_tpujob(worker=2)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        pods = cluster.list_pods(selector={"job-name": job.metadata.name})
+        cluster.set_pod_phase("default", pods[0].metadata.name, PodPhase.FAILED, exit_code=1)
+        with pytest.raises(EvictionBlocked):
+            cluster.evict_pod("default", pods[1].metadata.name)

@@ -184,3 +184,19 @@ class PodGroup:
     queue: str = ""
     # Filled by the scheduler/slice-allocator: "Pending" | "Inqueue" | "Running"
     phase: str = "Pending"
+
+
+@dataclass
+class PodDisruptionBudget:
+    """PDB analogue: guards *voluntary* evictions of a gang's pods.
+
+    The reference offers this as the non-Volcano gang mechanism
+    (SyncPdb/DeletePdb, vendor/.../common/job_controller.go:242-316):
+    min_available = total replicas means no voluntary disruption may take a
+    slice host away from a running gang.  Involuntary failures (crashes,
+    preemption) are not guarded — they flow through the restart state machine.
+    """
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    min_available: int = 0
+    selector: Dict[str, str] = field(default_factory=dict)

@@ -22,8 +22,6 @@ from .transformer import Block, TransformerConfig
 class PipelinedTransformerLM:
     def __init__(self, cfg: TransformerConfig, mesh: Mesh,
                  num_microbatches: int = 4, pp_axis: str = "pp") -> None:
-        if cfg.mesh is not None and cfg.ring_axis in (cfg.mesh.axis_names or ()):
-            pass  # ring attention inside blocks composes with pp
         self.cfg = cfg
         self.mesh = mesh
         self.num_microbatches = num_microbatches

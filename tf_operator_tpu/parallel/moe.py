@@ -102,7 +102,12 @@ class MoEMLP(nn.Module):
 
 
 def moe_aux_loss(intermediates) -> jax.Array:
-    """Sum the sown per-layer aux losses from model.apply(..., mutable=['intermediates'])."""
+    """Mean of the sown per-layer aux losses from
+    model.apply(..., mutable=['intermediates']).
+
+    Mean (not sum) keeps the effective balancing weight independent of model
+    depth — `moe_aux_weight` tunes identically for 2-layer tests and deep
+    stacks."""
     losses = []
 
     def visit(node):

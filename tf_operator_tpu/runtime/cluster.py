@@ -27,7 +27,7 @@ import time
 from enum import Enum
 from typing import Callable, Dict, List, Optional, Tuple
 
-from ..api.core import Event, ObjectMeta, Pod, PodGroup, Service
+from ..api.core import Event, ObjectMeta, Pod, PodDisruptionBudget, PodGroup, Service
 from ..api.types import JobStatus, TPUJob
 
 
@@ -42,6 +42,10 @@ WatchHandler = Callable[[EventType, object], None]
 
 class NotFound(KeyError):
     pass
+
+
+class EvictionBlocked(RuntimeError):
+    """A voluntary eviction was refused because it would violate a PDB."""
 
 
 class AlreadyExists(ValueError):
@@ -76,6 +80,16 @@ class ClusterInterface:
     def get_podgroup(self, namespace: str, name: str) -> PodGroup: ...
     def delete_podgroup(self, namespace: str, name: str) -> None: ...
 
+    # PodDisruptionBudgets (the non-Volcano gang mechanism,
+    # ref: SyncPdb/DeletePdb, common/job_controller.go:242-316)
+    def create_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget: ...
+    def get_pdb(self, namespace: str, name: str) -> PodDisruptionBudget: ...
+    def delete_pdb(self, namespace: str, name: str) -> None: ...
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """Voluntary eviction: delete the pod unless a PDB forbids it."""
+        ...
+
     # events
     def record_event(self, event: Event) -> None: ...
     def list_events(self, namespace: Optional[str] = None, object_name: Optional[str] = None) -> List[Event]: ...
@@ -104,6 +118,8 @@ class InMemoryCluster(ClusterInterface):
         self._pods: Dict[Tuple[str, str], Pod] = {}
         self._services: Dict[Tuple[str, str], Service] = {}
         self._podgroups: Dict[Tuple[str, str], PodGroup] = {}
+        self._pdbs: Dict[Tuple[str, str], PodDisruptionBudget] = {}
+        self._gang_scheduler_names: set = set()
         self._events: List[Event] = []
         self._leases: Dict[str, Tuple[str, float]] = {}  # name -> (holder, expiry)
         self._job_handlers: List[WatchHandler] = []
@@ -187,14 +203,21 @@ class InMemoryCluster(ClusterInterface):
         self._dispatch(self._pod_handlers, EventType.ADDED, pod)
         return pod
 
+    def register_gang_scheduler(self, scheduler_name: str) -> None:
+        """A GangScheduler announces it owns admission for this name."""
+        with self._lock:
+            self._gang_scheduler_names.add(scheduler_name)
+
     def _requires_gang_binding(self, pod: Pod) -> bool:
-        # Any scheduler name + gang-group annotation means a gang scheduler
-        # owns admission (the name is configurable via --gang-scheduler-name,
-        # so matching a fixed constant here would silently bypass holding).
+        # Hold a pod unbound only when a registered gang scheduler owns its
+        # scheduler name.  A template-set scheduler_name with nobody admitting
+        # it (e.g. pdb-mode gangs, custom names) must start normally, not hang
+        # Pending forever.
         from ..api import constants
 
         return bool(
             pod.spec.scheduler_name
+            and pod.spec.scheduler_name in self._gang_scheduler_names
             and pod.metadata.annotations.get(constants.GANG_GROUP_ANNOTATION)
         )
 
@@ -295,6 +318,65 @@ class InMemoryCluster(ClusterInterface):
         with self._lock:
             if self._podgroups.pop((namespace, name), None) is None:
                 raise NotFound(f"podgroup {namespace}/{name} not found")
+
+    # --- pod disruption budgets ---
+
+    def create_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        key = (pdb.metadata.namespace, pdb.metadata.name)
+        with self._lock:
+            if key in self._pdbs:
+                raise AlreadyExists(f"pdb {key} already exists")
+            self._assign_uid(pdb.metadata, "pdb")
+            self._pdbs[key] = pdb
+        return pdb
+
+    def get_pdb(self, namespace: str, name: str) -> PodDisruptionBudget:
+        with self._lock:
+            try:
+                return self._pdbs[(namespace, name)]
+            except KeyError:
+                raise NotFound(f"pdb {namespace}/{name} not found") from None
+
+    def delete_pdb(self, namespace: str, name: str) -> None:
+        with self._lock:
+            if self._pdbs.pop((namespace, name), None) is None:
+                raise NotFound(f"pdb {namespace}/{name} not found")
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """Voluntary eviction honoring PDBs (the k8s Eviction API contract:
+        PDBs guard evictions, not direct deletes)."""
+        from ..api.core import PodPhase
+
+        with self._lock:
+            pod = self.get_pod(namespace, name)
+            for pdb in self._pdbs.values():
+                if pdb.metadata.namespace != namespace:
+                    continue
+                if not _matches(pod.metadata.labels, pdb.selector):
+                    continue
+                healthy = [
+                    p
+                    for p in self._pods.values()
+                    if p.metadata.namespace == namespace
+                    and _matches(p.metadata.labels, pdb.selector)
+                    and p.status.phase not in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+                ]
+                # Evicting an already-terminal pod disrupts nothing: only
+                # subtract when the target is part of the healthy set.
+                after = len(healthy) - (1 if pod in healthy else 0)
+                if after < pdb.min_available:
+                    raise EvictionBlocked(
+                        f"eviction of {namespace}/{name} would violate pdb "
+                        f"{pdb.metadata.name}: {after} healthy < "
+                        f"minAvailable {pdb.min_available}"
+                    )
+            # Remove inside the lock: check-then-delete must be atomic or two
+            # concurrent evictions can each see the other's victim as still
+            # healthy and jointly violate the budget.  Watch dispatch happens
+            # outside — handlers take their own locks.
+            self._pods.pop((namespace, name), None)
+        self._stopped_pod(pod)
+        self._dispatch(self._pod_handlers, EventType.DELETED, pod)
 
     # --- events ---
 

@@ -78,6 +78,12 @@ class LocalProcessCluster(InMemoryCluster):
         for e in container.env:
             env[e.name] = e.value
         env.setdefault("PYTHONUNBUFFERED", "1")
+        # Pods run with cwd=workdir; make sure `python -m tf_operator_tpu...`
+        # workloads resolve regardless of where the operator was launched from.
+        pkg_root = str(Path(__file__).resolve().parents[2])
+        parts = env.get("PYTHONPATH", "").split(os.pathsep) if env.get("PYTHONPATH") else []
+        if pkg_root not in parts:
+            env["PYTHONPATH"] = os.pathsep.join(parts + [pkg_root])
         log_path = self.workdir / f"{pod.metadata.namespace}-{pod.metadata.name}.log"
         try:
             logf = open(log_path, "ab")

@@ -100,6 +100,11 @@ class ReconcilerConfig:
     reconciler_sync_loop_period: float = 15.0
     enable_gang_scheduling: bool = False
     gang_scheduler_name: str = constants.GANG_SCHEDULER_NAME
+    # "podgroup": all-or-nothing admission via PodGroup + gang scheduler
+    # (ref: SyncPodGroup, job_controller.go:211-239).  "pdb": default
+    # scheduler + PodDisruptionBudget guarding voluntary evictions
+    # (ref: SyncPdb, job_controller.go:242-316).
+    gang_mechanism: str = "podgroup"
 
 
 @dataclass
@@ -286,7 +291,7 @@ class JobReconciler:
                 # Re-sync when the TTL expires (ref: job.go:316-323 requeue).
                 result.requeue_after = ttl_remaining
             if self.config.enable_gang_scheduling:
-                self.delete_podgroup(job)
+                self.delete_gang(job)
             if conditions.is_succeeded(job.status):
                 for rs in job.status.replica_statuses.values():
                     rs.succeeded += rs.active
@@ -318,7 +323,7 @@ class JobReconciler:
             )
             self.delete_pods_and_services(job, pods)
             if self.config.enable_gang_scheduling:
-                self.delete_podgroup(job)
+                self.delete_gang(job)
             conditions.update_job_conditions(
                 job.status, conditions.JobConditionType.FAILED, failure_reason, failure_message
             )
@@ -333,7 +338,7 @@ class JobReconciler:
         # Gang scheduling: ensure the PodGroup exists before any pod
         # (ref: job.go:217-223; all-or-nothing slice allocation).
         if self.config.enable_gang_scheduling:
-            self.sync_podgroup(job)
+            self.sync_gang(job)
 
         # Fresh replica-status accounting for this pass
         # (ref: initializeReplicaStatuses, common/status.go).
@@ -494,8 +499,10 @@ class JobReconciler:
         _set_restart_policy(pod, rspec)
 
         if self.config.enable_gang_scheduling:
-            # (ref: pod.go:218-231 — scheduler name + group annotation)
-            if not pod.spec.scheduler_name:
+            # (ref: pod.go:218-231 — scheduler name + group annotation).
+            # The pdb mechanism keeps the default scheduler: protection comes
+            # from the budget, not from admission.
+            if self.config.gang_mechanism != "pdb" and not pod.spec.scheduler_name:
                 pod.spec.scheduler_name = self.config.gang_scheduler_name
             pod.metadata.annotations[constants.GANG_GROUP_ANNOTATION] = job.metadata.name
 
@@ -666,6 +673,55 @@ class JobReconciler:
             metrics.deleted_podgroups.labels().inc()
         except NotFound:
             pass
+
+    def sync_pdb(self, job: TPUJob):
+        """(ref: SyncPdb, common/job_controller.go:242-276)"""
+        from ..api.core import PodDisruptionBudget
+        from ..api.defaults import total_replicas
+
+        sp = job.spec.run_policy.scheduling_policy
+        min_available = (
+            sp.min_available
+            if sp is not None and sp.min_available is not None
+            else total_replicas(job)
+        )
+        try:
+            return self.cluster.get_pdb(job.metadata.namespace, job.metadata.name)
+        except NotFound:
+            pdb = PodDisruptionBudget(
+                metadata=ObjectMeta(
+                    name=job.metadata.name,
+                    namespace=job.metadata.namespace,
+                    owner_kind=job.kind,
+                    owner_name=job.metadata.name,
+                    owner_uid=job.metadata.uid,
+                ),
+                min_available=min_available,
+                selector=gen_labels(job.metadata.name),
+            )
+            created = self.cluster.create_pdb(pdb)
+            metrics.created_pdbs.labels().inc()
+            return created
+
+    def delete_pdb(self, job: TPUJob) -> None:
+        """(ref: DeletePdb, common/job_controller.go:299-316)"""
+        try:
+            self.cluster.delete_pdb(job.metadata.namespace, job.metadata.name)
+            metrics.deleted_pdbs.labels().inc()
+        except NotFound:
+            pass
+
+    def sync_gang(self, job: TPUJob) -> None:
+        if self.config.gang_mechanism == "pdb":
+            self.sync_pdb(job)
+        else:
+            self.sync_podgroup(job)
+
+    def delete_gang(self, job: TPUJob) -> None:
+        if self.config.gang_mechanism == "pdb":
+            self.delete_pdb(job)
+        else:
+            self.delete_podgroup(job)
 
     # ------------------------------------------------------------------
     # job-level limits

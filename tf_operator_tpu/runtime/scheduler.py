@@ -75,6 +75,9 @@ class GangScheduler:
         self._admitted: Dict[str, float] = {}
         # group key -> member pod names currently existing
         self._members: Dict[str, Set[str]] = {}
+        register = getattr(cluster, "register_gang_scheduler", None)
+        if register is not None:
+            register(scheduler_name)
         cluster.watch_pods(self._on_pod_event)
 
     @staticmethod

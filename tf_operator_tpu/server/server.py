@@ -46,6 +46,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         action="store_false")
     parser.add_argument("--enable-gang-scheduling", action="store_true")
     parser.add_argument("--gang-scheduler-name", default="tpu-gang")
+    parser.add_argument("--gang-mechanism", choices=("podgroup", "pdb"),
+                        default="podgroup",
+                        help="podgroup: all-or-nothing slice admission; "
+                        "pdb: default scheduler + disruption budget "
+                        "(ref: SyncPodGroup vs SyncPdb)")
     parser.add_argument("--slice-chips", type=float, default=None,
                         help="total TPU chips the gang scheduler may admit "
                              "(default unlimited)")
@@ -159,6 +164,7 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
         reconciler_sync_loop_period=args.resync_period,
         enable_gang_scheduling=args.enable_gang_scheduling,
         gang_scheduler_name=args.gang_scheduler_name,
+        gang_mechanism=args.gang_mechanism,
     )
     resolver_owner = cluster if hasattr(cluster, "resolver") else None
     controller = TPUJobController(
@@ -167,7 +173,7 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
         threadiness=args.threadiness,
         **({"resolver": resolver_owner.resolver} if resolver_owner else {}),
     )
-    if args.enable_gang_scheduling:
+    if args.enable_gang_scheduling and args.gang_mechanism == "podgroup":
         from ..runtime.scheduler import GangScheduler
 
         controller.gang_scheduler = GangScheduler(

@@ -93,6 +93,8 @@ class _NativeIterator:
         return self
 
     def __next__(self) -> Dict[str, np.ndarray]:
+        if self._handle is None:
+            raise StopIteration  # closed: a NULL handle would segfault in C++
         rc = self._lib.dl_next(
             self._handle,
             self._x_buf.ctypes.data_as(ctypes.c_void_p),

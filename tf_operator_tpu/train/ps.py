@@ -115,6 +115,8 @@ class PSClient:
         self.addresses = addresses
         self._socks: List[Optional[socket.socket]] = [None] * len(addresses)
         self.timeout = timeout
+        # name -> shard index, learned from pull(); authoritative routing.
+        self._routes: Dict[str, int] = {}
 
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
@@ -128,16 +130,25 @@ class PSClient:
         for i in range(len(self.addresses)):
             _send(self._sock(i), ("pull",))
             shard, _version = _recv(self._sock(i))
+            for name in shard:
+                self._routes[name] = i
             merged.update(shard)
         return merged
 
     def push(self, grads: Dict[str, np.ndarray], num_ps: Optional[int] = None) -> None:
-        num_ps = num_ps or len(self.addresses)
-        names = sorted(grads)
-        for i in range(len(self.addresses)):
-            mine = {n: grads[n] for n in shard_names(names, num_ps, i)}
-            if not mine:
-                continue
+        # Route by the servers' actual shard assignment (learned on pull).
+        # Re-deriving routes from sorted(grads) would mis-shard any partial
+        # push (e.g. frozen layers excluded) and the server would silently
+        # drop the misrouted grads.
+        if not self._routes:
+            self.pull()
+        unknown = [n for n in grads if n not in self._routes]
+        if unknown:
+            raise KeyError(f"params not hosted by any PS shard: {unknown}")
+        by_shard: Dict[int, Dict[str, np.ndarray]] = {}
+        for name, grad in grads.items():
+            by_shard.setdefault(self._routes[name], {})[name] = grad
+        for i, mine in by_shard.items():
             _send(self._sock(i), ("push", mine))
             _recv(self._sock(i))
 

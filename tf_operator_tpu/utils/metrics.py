@@ -138,6 +138,12 @@ created_podgroups = REGISTRY.counter(
 deleted_podgroups = REGISTRY.counter(
     "tpu_operator_deleted_podgroups_total", "Counts number of podgroups deleted"
 )
+created_pdbs = REGISTRY.counter(
+    "tpu_operator_created_pdbs_total", "Counts number of pod disruption budgets created"
+)
+deleted_pdbs = REGISTRY.counter(
+    "tpu_operator_deleted_pdbs_total", "Counts number of pod disruption budgets deleted"
+)
 is_leader = REGISTRY.gauge(
     "tpu_operator_is_leader", "Whether this operator instance is the leader"
 )

@@ -19,13 +19,9 @@ import sys
 
 
 def main() -> int:
-    forced = os.environ.get("TPUJOB_FORCE_PLATFORM")
-    if forced:
-        import jax
+    from .runner import WorkloadContext, apply_forced_platform
 
-        jax.config.update("jax_platforms", forced)
-
-    from .runner import WorkloadContext
+    apply_forced_platform()
 
     ctx = WorkloadContext.from_env()
     print(

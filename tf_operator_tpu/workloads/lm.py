@@ -32,13 +32,9 @@ def main(argv=None) -> int:
     parser.add_argument("--moe-aux-weight", type=float, default=0.01)
     args = parser.parse_args(argv)
 
-    forced = os.environ.get("TPUJOB_FORCE_PLATFORM")
-    if forced:
-        import jax
+    from .runner import WorkloadContext, apply_forced_platform
 
-        jax.config.update("jax_platforms", forced)
-
-    from .runner import WorkloadContext
+    apply_forced_platform()
 
     ctx = WorkloadContext.from_env()
     print(f"lm workload: role={ctx.replica_type} index={ctx.replica_index} "

@@ -31,13 +31,9 @@ def main(argv=None) -> int:
     # Test hook: the local runtime forces CPU for pod subprocesses so they
     # don't contend for the host's TPU (sitecustomize pins jax_platforms,
     # so env alone is not enough — see tests/conftest.py).
-    forced = os.environ.get("TPUJOB_FORCE_PLATFORM")
-    if forced:
-        import jax
+    from .runner import WorkloadContext, apply_forced_platform
 
-        jax.config.update("jax_platforms", forced)
-
-    from .runner import WorkloadContext
+    apply_forced_platform()
 
     ctx = WorkloadContext.from_env()
     print(f"mnist workload: role={ctx.replica_type} index={ctx.replica_index} "

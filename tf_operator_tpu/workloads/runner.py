@@ -17,6 +17,18 @@ from typing import Dict, Optional
 from ..api import constants
 
 
+def apply_forced_platform(env: Optional[Dict[str, str]] = None) -> None:
+    """Honor TPUJOB_FORCE_PLATFORM (e.g. 'cpu' for hermetic e2e tests).
+
+    Must run before the first jax backend initialization in the pod process.
+    """
+    forced = (os.environ if env is None else env).get("TPUJOB_FORCE_PLATFORM")
+    if forced:
+        import jax
+
+        jax.config.update("jax_platforms", forced)
+
+
 @dataclass
 class WorkloadContext:
     replica_type: str = "worker"

@@ -19,13 +19,9 @@ def main(argv=None) -> int:
     parser.add_argument("--size", type=int, default=1024)
     args = parser.parse_args(argv)
 
-    forced = os.environ.get("TPUJOB_FORCE_PLATFORM")
-    if forced:
-        import jax
+    from .runner import WorkloadContext, apply_forced_platform
 
-        jax.config.update("jax_platforms", forced)
-
-    from .runner import WorkloadContext
+    apply_forced_platform()
 
     ctx = WorkloadContext.from_env()
     print(f"smoke: role={ctx.replica_type} index={ctx.replica_index} "
