@@ -1,0 +1,104 @@
+"""Elastic (dynamic-worker) E2E on real local processes.
+
+Unit-level sparse-spec and scale diffing are covered in test_topology.py and
+test_reconciler.py; this suite runs the full loop — controller + subprocesses —
+the way the reference's distributed_training_tests.py exercises
+EnableDynamicWorker (tensorflow.go:64-83, pod_test.go:404-552).
+"""
+import json
+import sys
+
+import pytest
+
+from tf_operator_tpu.api.core import Container, ObjectMeta, PodTemplateSpec
+from tf_operator_tpu.api.types import (
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+)
+
+from test_local_e2e import local_stack, wait_until, _patch_pod_name_env  # noqa: F401
+
+pytestmark = pytest.mark.slow
+
+
+def make_elastic_job(name, ctrl_dir, workers=2, ps=1):
+    container = Container(
+        name="tensorflow",
+        image="local",
+        command=[sys.executable, "-m", "tf_operator_tpu.workloads.test_server"],
+        args=["--ctrl-dir", str(ctrl_dir)],
+    )
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(
+            enable_dynamic_worker=True,
+            replica_specs={
+                ReplicaType.PS: ReplicaSpec(
+                    replicas=ps,
+                    restart_policy=RestartPolicy.NEVER,
+                    template=PodTemplateSpec(containers=[container]),
+                ),
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=workers,
+                    restart_policy=RestartPolicy.NEVER,
+                    template=PodTemplateSpec(containers=[container]),
+                ),
+            },
+        ),
+    )
+
+
+def test_sparse_spec_and_scale_up_down(local_stack):
+    cluster, controller, client, tmp = local_stack
+    ctrl = tmp / "ctrl"
+    _patch_pod_name_env(cluster)
+    client.create(make_elastic_job("elastic", ctrl, workers=2, ps=1))
+
+    assert wait_until(
+        lambda: len(list(ctrl.glob("*.env.json"))) == 3, timeout=30
+    ), "initial pods did not start"
+
+    # each worker sees only itself + all PS (sparse spec)
+    view = json.loads((ctrl / "elastic-worker-1.env.json").read_text())
+    tf_config = json.loads(view["TF_CONFIG"])
+    assert "sparseCluster" in tf_config
+    sparse = tf_config["sparseCluster"]
+    assert list(sparse["worker"].keys()) == ["1"]
+    assert len(sparse["ps"]) == 1
+    assert tf_config["task"] == {"type": "worker", "index": 1}
+
+    # scale up 2 → 4: exactly the new indices appear, old pods untouched
+    client.patch(
+        "elastic",
+        lambda j: setattr(j.spec.replica_specs[ReplicaType.WORKER], "replicas", 4),
+    )
+    assert wait_until(
+        lambda: (ctrl / "elastic-worker-3.env.json").exists(), timeout=30
+    ), "scale-up pods did not start"
+    view3 = json.loads((ctrl / "elastic-worker-3.env.json").read_text())
+    assert json.loads(view3["TF_CONFIG"])["task"]["index"] == 3
+
+    # scale down 4 → 1: out-of-range indices are deleted (their processes die)
+    client.patch(
+        "elastic",
+        lambda j: setattr(j.spec.replica_specs[ReplicaType.WORKER], "replicas", 1),
+    )
+
+    def only_one_worker_left():
+        pods = cluster.list_pods(selector={"job-name": "elastic"})
+        workers = [
+            p for p in pods
+            if p.metadata.labels.get("replica-type", "").lower() == "worker"
+            and p.status.phase.value in ("Pending", "Running")
+        ]
+        return len(workers) == 1 and workers[0].metadata.name == "elastic-worker-0"
+
+    assert wait_until(only_one_worker_left, timeout=30), "scale-down did not converge"
+
+    # the survivors finish → job Succeeded (worker-0 rule)
+    (ctrl / "all.cmd").write_text("exit 0")
+    client.wait_for_job("elastic", timeout=30)
+    assert client.is_job_succeeded("elastic")

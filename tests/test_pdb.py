@@ -48,6 +48,23 @@ class TestPdbLifecycle:
         assert pdb.selector["job-name"] == job.metadata.name
         assert pdb.metadata.owner_name == job.metadata.name
 
+    def test_scale_refreshes_min_available(self):
+        """Elastic scale-up must grow the disruption budget, or evictions are
+        judged against a stale gang size."""
+        from tf_operator_tpu.api.types import ReplicaType
+
+        controller, cluster = pdb_stack()
+        job = new_tpujob(worker=2, ps=1)
+        job.spec.enable_dynamic_worker = True
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert cluster.get_pdb("default", job.metadata.name).min_available == 3
+
+        job.spec.replica_specs[ReplicaType.WORKER].replicas = 4
+        cluster.update_job(job)
+        controller.sync_job(job.key())
+        assert cluster.get_pdb("default", job.metadata.name).min_available == 5
+
     def test_min_available_from_scheduling_policy(self):
         controller, cluster = pdb_stack()
         job = new_tpujob(worker=4)

@@ -1,0 +1,55 @@
+"""Pod/service naming contract (ref: pod_names_validation_tests.py + the
+`job-rt-idx` naming at common/pod.go:411-506, service.go:277-339).
+
+Names are user-visible API: stable DNS identity across restarts is what lets a
+restarted replica rejoin the same cluster spec, so the exact shape
+`<job>-<replicatype lowercase>-<index>` is pinned by tests.
+"""
+from tf_operator_tpu.api.types import ReplicaType
+from tf_operator_tpu.runtime.reconciler import gen_general_name, gen_labels
+
+from testutil import new_controller, new_tpujob
+
+
+def _sync(ctr, cluster, job):
+    cluster.create_job(job)
+    ctr.add_job(job)
+    ctr.sync_job(job.key())
+
+
+def test_pod_and_service_names_full_replica_map():
+    ctr, cluster, pod_control, svc_control = new_controller()
+    job = new_tpujob(worker=2, ps=2, chief=1, evaluator=1, name="names-job")
+    _sync(ctr, cluster, job)
+
+    expected = {
+        "names-job-chief-0",
+        "names-job-evaluator-0",
+        "names-job-ps-0",
+        "names-job-ps-1",
+        "names-job-worker-0",
+        "names-job-worker-1",
+    }
+    assert {p.metadata.name for p in pod_control.pods} == expected
+    assert {s.metadata.name for s in svc_control.services} == expected
+
+
+def test_gen_general_name_lowercases_replica_type():
+    assert gen_general_name("j", ReplicaType.PS.value, 3) == "j-ps-3"
+    assert gen_general_name("j", ReplicaType.WORKER.value, 0) == "j-worker-0"
+    assert gen_general_name("j", ReplicaType.EVALUATOR.value, 1) == "j-evaluator-1"
+
+
+def test_labels_identify_replica():
+    ctr, cluster, pod_control, svc_control = new_controller()
+    job = new_tpujob(worker=2, name="label-job")
+    _sync(ctr, cluster, job)
+    by_name = {p.metadata.name: p for p in pod_control.pods}
+    pod = by_name["label-job-worker-1"]
+    labels = pod.metadata.labels
+    assert labels["replica-index"] == "1"
+    assert labels["replica-type"].lower() == "worker"
+    assert labels["job-name"] == "label-job"
+    base = gen_labels("label-job")
+    for key, value in base.items():
+        assert labels[key] == value

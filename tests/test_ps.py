@@ -50,7 +50,7 @@ def test_sharding_across_servers():
     merged = client.pull()
     assert sorted(merged) == sorted(names)
     # push routes each leaf to its owning shard only
-    client.push({n: np.ones(2, np.float32) for n in names}, num_ps=2)
+    client.push({n: np.ones(2, np.float32) for n in names})
     after = client.pull()
     for name in names:
         np.testing.assert_allclose(after[name], all_params[name] - 0.5)

@@ -1,0 +1,71 @@
+"""SDK watch + version metadata (ref: tf_job_watch.py:29-59, version.go:21-43)."""
+import threading
+import time
+
+from tf_operator_tpu.api.types import JobConditionType
+from tf_operator_tpu.runtime import conditions
+from tf_operator_tpu.runtime.cluster import InMemoryCluster
+from tf_operator_tpu.sdk.client import TPUJobClient
+from tf_operator_tpu.sdk.watch import watch
+from tf_operator_tpu.version import version_info, version_string
+
+from testutil import new_tpujob
+
+
+def test_watch_logs_transitions_until_terminal():
+    cluster = InMemoryCluster()
+    job = new_tpujob(worker=1, name="watched")
+    conditions.update_job_conditions(
+        job.status, JobConditionType.CREATED, "TPUJobCreated", "created"
+    )
+    cluster.create_job(job)
+    client = TPUJobClient(cluster)
+    rows = []
+
+    def drive():
+        time.sleep(0.3)
+        conditions.update_job_conditions(
+            job.status, JobConditionType.RUNNING, "TPUJobRunning", "running"
+        )
+        cluster.update_job(job)
+        time.sleep(0.3)
+        conditions.update_job_conditions(
+            job.status, JobConditionType.SUCCEEDED, "TPUJobSucceeded", "done"
+        )
+        cluster.update_job(job)
+
+    thread = threading.Thread(target=drive)
+    thread.start()
+    final = watch(client, "watched", timeout=10, poll_interval=0.05,
+                  printer=rows.append)
+    thread.join()
+
+    assert rows[0].split() == ["NAME", "STATE", "TIME"]
+    states = [row.split()[1] for row in rows[1:]]
+    assert states == ["Created", "Running", "Succeeded"]
+    assert any(
+        c.type == JobConditionType.SUCCEEDED and c.status
+        for c in final.status.conditions
+    )
+
+
+def test_watch_times_out():
+    cluster = InMemoryCluster()
+    job = new_tpujob(worker=1, name="stuck")
+    cluster.create_job(job)
+    client = TPUJobClient(cluster)
+    try:
+        watch(client, "stuck", timeout=0.3, poll_interval=0.05,
+              printer=lambda _row: None)
+        raise AssertionError("expected TimeoutError")
+    except TimeoutError:
+        pass
+
+
+def test_version_info_shape():
+    info = version_info()
+    assert set(info) == {"version", "git_sha", "python", "platform"}
+    assert info["version"] == "0.1.0"
+    text = version_string()
+    assert text.startswith("tpu-operator 0.1.0")
+    assert "python" in text

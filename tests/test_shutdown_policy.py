@@ -1,0 +1,117 @@
+"""Shutdown-policy E2E (ref: py/kubeflow/tf_operator/shutdown_policy_tests.py).
+
+The reference's suite terminates the coordinating replica (chief, or worker-0
+for worker-only jobs) while other replicas are still running and asserts the
+job completes.  Here the pods are real local processes driven through the
+controllable test-server workload.
+"""
+import sys
+
+import pytest
+
+from tf_operator_tpu.api.core import Container, ObjectMeta, PodTemplateSpec
+from tf_operator_tpu.api.types import (
+    ReplicaSpec,
+    ReplicaType,
+    RestartPolicy,
+    TPUJob,
+    TPUJobSpec,
+)
+
+from test_local_e2e import local_stack, wait_until, _patch_pod_name_env  # noqa: F401
+
+pytestmark = pytest.mark.slow
+
+
+def _server_container(ctrl_dir):
+    return Container(
+        name="tensorflow",
+        image="local",
+        command=[sys.executable, "-m", "tf_operator_tpu.workloads.test_server"],
+        args=["--ctrl-dir", str(ctrl_dir)],
+    )
+
+
+def make_chief_worker_job(name, ctrl_dir, workers=2):
+    container = _server_container(ctrl_dir)
+    return TPUJob(
+        metadata=ObjectMeta(name=name),
+        spec=TPUJobSpec(replica_specs={
+            ReplicaType.CHIEF: ReplicaSpec(
+                replicas=1,
+                restart_policy=RestartPolicy.NEVER,
+                template=PodTemplateSpec(containers=[container]),
+            ),
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=workers,
+                restart_policy=RestartPolicy.NEVER,
+                template=PodTemplateSpec(containers=[container]),
+            ),
+        }),
+    )
+
+
+def test_chief_shutdown_completes_job(local_stack):
+    """Kill the chief with exit 0 while workers still run → Succeeded
+    (ref: shutdown_policy_tests.py:25-60 — chief completion ends the job)."""
+    cluster, controller, client, tmp = local_stack
+    ctrl = tmp / "ctrl"
+    _patch_pod_name_env(cluster)
+    job = make_chief_worker_job("shutdown-chief", ctrl, workers=2)
+    client.create(job)
+
+    assert wait_until(
+        lambda: len(list(ctrl.glob("*.env.json"))) == 3, timeout=30
+    ), "pods did not all start"
+    assert wait_until(
+        lambda: client.is_job_running("shutdown-chief"), timeout=20
+    )
+
+    # terminate only the chief; workers keep polling their cmd files
+    (ctrl / "shutdown-chief-chief-0.cmd").write_text("exit 0")
+    client.wait_for_job("shutdown-chief", timeout=30)
+    assert client.is_job_succeeded("shutdown-chief")
+
+
+def test_worker0_shutdown_completes_job(local_stack):
+    """Worker-only job: kill worker-0 with exit 0, others still running →
+    Succeeded under the default success policy
+    (ref: shutdown_policy_tests.py:62-97)."""
+    cluster, controller, client, tmp = local_stack
+    ctrl = tmp / "ctrl"
+    _patch_pod_name_env(cluster)
+    container = _server_container(ctrl)
+    job = TPUJob(
+        metadata=ObjectMeta(name="shutdown-w0"),
+        spec=TPUJobSpec(replica_specs={
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=3,
+                restart_policy=RestartPolicy.NEVER,
+                template=PodTemplateSpec(containers=[container]),
+            ),
+        }),
+    )
+    client.create(job)
+    assert wait_until(
+        lambda: len(list(ctrl.glob("*.env.json"))) == 3, timeout=30
+    )
+    (ctrl / "shutdown-w0-worker-0.cmd").write_text("exit 0")
+    client.wait_for_job("shutdown-w0", timeout=30)
+    assert client.is_job_succeeded("shutdown-w0")
+
+
+def test_chief_failure_fails_job(local_stack):
+    """Chief exiting non-zero with restartPolicy=Never fails the whole job —
+    the inverse case the reference covers via status rules
+    (status.go:168-195)."""
+    cluster, controller, client, tmp = local_stack
+    ctrl = tmp / "ctrl"
+    _patch_pod_name_env(cluster)
+    job = make_chief_worker_job("shutdown-fail", ctrl, workers=1)
+    client.create(job)
+    assert wait_until(
+        lambda: len(list(ctrl.glob("*.env.json"))) == 2, timeout=30
+    )
+    (ctrl / "shutdown-fail-chief-0.cmd").write_text("exit 1")
+    client.wait_for_condition("shutdown-fail", ["Failed"], timeout=30)
+    assert client.get_job_status("shutdown-fail") == "Failed"

@@ -104,6 +104,25 @@ def cmd_events(args) -> int:
     return 0
 
 
+def cmd_watch(args) -> int:
+    from tf_operator_tpu.sdk.watch import watch
+
+    client = _client(args)
+    try:
+        watch(client, args.name, timeout=args.timeout)
+    except TimeoutError as err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    return 0 if client.is_job_succeeded(args.name) else 1
+
+
+def cmd_version(args) -> int:
+    from tf_operator_tpu.version import version_string
+
+    print(version_string())
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser("tpujob")
     parser.add_argument("--server", default="http://127.0.0.1:8008")
@@ -136,6 +155,14 @@ def main(argv=None) -> int:
     p = sub.add_parser("events")
     p.add_argument("name")
     p.set_defaults(fn=cmd_events)
+
+    p = sub.add_parser("watch")
+    p.add_argument("name")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.set_defaults(fn=cmd_watch)
+
+    p = sub.add_parser("version")
+    p.set_defaults(fn=cmd_version)
 
     args = parser.parse_args(argv)
     return args.fn(args)

@@ -84,6 +84,7 @@ class ClusterInterface:
     # ref: SyncPdb/DeletePdb, common/job_controller.go:242-316)
     def create_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget: ...
     def get_pdb(self, namespace: str, name: str) -> PodDisruptionBudget: ...
+    def update_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget: ...
     def delete_pdb(self, namespace: str, name: str) -> None: ...
 
     def evict_pod(self, namespace: str, name: str) -> None:
@@ -336,6 +337,14 @@ class InMemoryCluster(ClusterInterface):
                 return self._pdbs[(namespace, name)]
             except KeyError:
                 raise NotFound(f"pdb {namespace}/{name} not found") from None
+
+    def update_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        key = (pdb.metadata.namespace, pdb.metadata.name)
+        with self._lock:
+            if key not in self._pdbs:
+                raise NotFound(f"pdb {key} not found")
+            self._pdbs[key] = pdb
+        return pdb
 
     def delete_pdb(self, namespace: str, name: str) -> None:
         with self._lock:

@@ -686,7 +686,13 @@ class JobReconciler:
             else total_replicas(job)
         )
         try:
-            return self.cluster.get_pdb(job.metadata.namespace, job.metadata.name)
+            pdb = self.cluster.get_pdb(job.metadata.namespace, job.metadata.name)
+            if pdb.min_available != min_available:
+                # Elastic scale changed the gang size: refresh the budget so
+                # voluntary evictions are judged against the live replica count.
+                pdb.min_available = min_available
+                pdb = self.cluster.update_pdb(pdb)
+            return pdb
         except NotFound:
             pdb = PodDisruptionBudget(
                 metadata=ObjectMeta(

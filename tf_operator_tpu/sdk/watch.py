@@ -1,0 +1,53 @@
+"""Table-logged job watch (ref: sdk/python/kubeflow/tfjob/api/tf_job_watch.py:29-59).
+
+The reference polls the CRD watch API and prints NAME/STATE/TIME rows until the
+job reaches Succeeded or Failed.  Here the poll goes through ClusterInterface
+(in-memory, local-process, or remote HTTP — same seam everywhere) and rows are
+emitted only on state transitions, so a long Running phase prints one line.
+"""
+from __future__ import annotations
+
+import time
+from datetime import datetime, timezone
+from typing import Callable, Optional
+
+from tf_operator_tpu.api.types import JobConditionType, TPUJob
+
+TERMINAL = (JobConditionType.SUCCEEDED, JobConditionType.FAILED)
+_FMT = "{:<32} {:<12} {:<24}"
+
+
+def _state(job: TPUJob) -> str:
+    for cond in reversed(job.status.conditions):
+        if cond.status:
+            return cond.type.value if hasattr(cond.type, "value") else str(cond.type)
+    return "Created"
+
+
+def watch(
+    client,
+    name: str,
+    namespace: Optional[str] = None,
+    timeout: float = 600.0,
+    poll_interval: float = 1.0,
+    printer: Callable[[str], None] = print,
+) -> TPUJob:
+    """Poll the job, printing a table row on every state transition, until a
+    terminal condition or timeout.  Returns the final job object."""
+    printer(_FMT.format("NAME", "STATE", "TIME"))
+    deadline = time.time() + timeout
+    last_state = None
+    while True:
+        job = client.get(name, namespace)
+        state = _state(job)
+        if state != last_state:
+            stamp = datetime.now(timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+            printer(_FMT.format(name, state, stamp))
+            last_state = state
+        if any(c.type in TERMINAL and c.status for c in job.status.conditions):
+            return job
+        if time.time() >= deadline:
+            raise TimeoutError(
+                f"timeout waiting for job {name} to finish (last state {state})"
+            )
+        time.sleep(poll_interval)

@@ -135,7 +135,7 @@ class PSClient:
             merged.update(shard)
         return merged
 
-    def push(self, grads: Dict[str, np.ndarray], num_ps: Optional[int] = None) -> None:
+    def push(self, grads: Dict[str, np.ndarray]) -> None:
         # Route by the servers' actual shard assignment (learned on pull).
         # Re-deriving routes from sorted(grads) would mis-shard any partial
         # push (e.g. frozen layers excluded) and the server would silently

@@ -77,8 +77,12 @@ def classification_loss_fn(apply_fn, has_batch_stats: bool = False,
     return loss
 
 
-def make_train_step(loss_fn, has_batch_stats: bool = False, donate: bool = True):
-    """Build `step(state, batch, rng) -> (state, metrics)` under jit."""
+def make_train_step(loss_fn, has_batch_stats: bool = False, donate: bool = True,
+                    jit: bool = True):
+    """Build `step(state, batch, rng) -> (state, metrics)` under jit.
+
+    jit=False returns the raw traceable step for callers that embed it in a
+    larger compiled region (e.g. `lax.scan` over steps in bench harnesses)."""
 
     def step(state: TrainState, batch, rng=None):
         rngs = {"dropout": rng} if rng is not None else None
@@ -97,6 +101,8 @@ def make_train_step(loss_fn, has_batch_stats: bool = False, donate: bool = True)
             metrics["moe_aux_loss"] = aux["moe_aux_loss"]
         return new_state, metrics
 
+    if not jit:
+        return step
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 

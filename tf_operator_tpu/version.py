@@ -1,0 +1,48 @@
+"""Build/version metadata (ref: pkg/version/version.go:21-43).
+
+The reference stamps Version + GitSHA at link time via -ldflags; a pure-Python
+package has no link step, so GitSHA is resolved lazily from the installed
+tree's git metadata when available and falls back to "unknown" — the printed
+shape (version, git sha, runtime) matches PrintVersionAndExit's output.
+"""
+from __future__ import annotations
+
+import os
+import platform
+import subprocess
+import sys
+
+from tf_operator_tpu import __version__
+
+VERSION = __version__
+
+
+def git_sha() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    try:
+        out = subprocess.run(
+            ["git", "-C", root, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+        )
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:
+        pass
+    return "unknown"
+
+
+def version_info() -> dict:
+    return {
+        "version": VERSION,
+        "git_sha": git_sha(),
+        "python": sys.version.split()[0],
+        "platform": f"{platform.system().lower()}/{platform.machine()}",
+    }
+
+
+def version_string() -> str:
+    info = version_info()
+    return (
+        f"tpu-operator {info['version']} (git {info['git_sha']}, "
+        f"python {info['python']}, {info['platform']})"
+    )

@@ -14,7 +14,6 @@ Usage: python -m tf_operator_tpu.workloads.allreduce_check
 """
 from __future__ import annotations
 
-import os
 import sys
 
 

@@ -5,7 +5,6 @@ Usage: python -m tf_operator_tpu.workloads.bert --steps 50
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 

@@ -12,7 +12,6 @@ Usage: python -m tf_operator_tpu.workloads.dist_mnist --steps 100
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 import time
 

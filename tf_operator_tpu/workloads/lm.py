@@ -11,7 +11,6 @@ Usage: python -m tf_operator_tpu.workloads.lm --steps 100 --seq-len 8192 \
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 

@@ -26,7 +26,6 @@ def main(argv=None) -> int:
     parser.add_argument("--target-loss", type=float, default=None)
     args = parser.parse_args(argv)
 
-    import os
 
     # Test hook: the local runtime forces CPU for pod subprocesses so they
     # don't contend for the host's TPU (sitecustomize pins jax_platforms,

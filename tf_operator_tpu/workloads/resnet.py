@@ -10,7 +10,6 @@ Usage: python -m tf_operator_tpu.workloads.resnet --steps 100 --batch 256
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 import time
 

@@ -10,7 +10,6 @@ Usage: python -m tf_operator_tpu.workloads.smoke [--size 1024]
 from __future__ import annotations
 
 import argparse
-import os
 import sys
 
 
