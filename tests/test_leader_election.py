@@ -1,0 +1,75 @@
+"""Leader election (ref: leaderelection.RunOrDie wiring, app/server.go:53-184).
+
+The contract under test: exactly one elector of N leads; a standby takes over
+after the lease expires; a leader that loses its lease calls on_lost_lease and
+exits its loop (the reference's fatal-restart model).
+"""
+import threading
+import time
+
+import tf_operator_tpu.server.server as server_mod
+from tf_operator_tpu.runtime.cluster import InMemoryCluster
+from tf_operator_tpu.server.server import LEASE_NAME, LeaderElector
+
+
+def run_elector(cluster, identity, events):
+    elector = LeaderElector(
+        cluster, identity,
+        on_started_leading=lambda: events.append(("lead", identity)),
+        on_lost_lease=lambda: events.append(("lost", identity)),
+    )
+    thread = threading.Thread(target=elector.run, daemon=True)
+    thread.start()
+    return elector, thread
+
+
+def test_single_leader_and_failover(monkeypatch):
+    monkeypatch.setattr(server_mod, "LEASE_DURATION", 0.5)
+    monkeypatch.setattr(server_mod, "RENEW_PERIOD", 0.1)
+    monkeypatch.setattr(server_mod, "RETRY_PERIOD", 0.1)
+
+    cluster = InMemoryCluster()
+    events = []
+    elector_a, thread_a = run_elector(cluster, "a", events)
+    time.sleep(0.3)
+    elector_b, thread_b = run_elector(cluster, "b", events)
+    time.sleep(0.3)
+
+    # only the first elector leads; the standby never fires its callback
+    assert ("lead", "a") in events
+    assert all(e[1] == "a" for e in events)
+    assert cluster.lease_holder(LEASE_NAME) == "a"
+
+    # leader dies (stops renewing) → lease expires → standby takes over
+    elector_a.stop()
+    thread_a.join(timeout=2)
+    deadline = time.time() + 3
+    while ("lead", "b") not in events and time.time() < deadline:
+        time.sleep(0.05)
+    assert ("lead", "b") in events
+    assert cluster.lease_holder(LEASE_NAME) == "b"
+    elector_b.stop()
+    thread_b.join(timeout=2)
+
+
+def test_lost_lease_invokes_fatal_callback(monkeypatch):
+    monkeypatch.setattr(server_mod, "LEASE_DURATION", 0.3)
+    monkeypatch.setattr(server_mod, "RENEW_PERIOD", 1.0)  # renew too slowly
+    monkeypatch.setattr(server_mod, "RETRY_PERIOD", 0.05)
+
+    cluster = InMemoryCluster()
+    events = []
+    elector_a, thread_a = run_elector(cluster, "a", events)
+    deadline = time.time() + 1
+    while ("lead", "a") not in events and time.time() < deadline:
+        time.sleep(0.02)
+    assert ("lead", "a") in events
+
+    # a rival grabs the expired lease while the leader sleeps through renew
+    time.sleep(0.4)
+    assert cluster.try_acquire_lease(LEASE_NAME, "b", 10.0)
+
+    thread_a.join(timeout=3)  # loop must exit after losing the lease
+    assert not thread_a.is_alive()
+    assert ("lost", "a") in events
+    elector_a.stop()

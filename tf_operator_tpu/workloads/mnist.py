@@ -24,6 +24,17 @@ def main(argv=None) -> int:
     parser.add_argument("--lr", type=float, default=1e-3)
     parser.add_argument("--model", choices=("mlp", "cnn"), default="mlp")
     parser.add_argument("--target-loss", type=float, default=None)
+    parser.add_argument("--checkpoint-dir", default=None,
+                        help="save/resume train state here (orbax)")
+    parser.add_argument("--save-every", type=int, default=0,
+                        help="checkpoint every N steps (0 = only on preempt)")
+    parser.add_argument("--preempt-at-step", type=int, default=None,
+                        help="simulate TPU-VM preemption: checkpoint, then "
+                        "exit with --preempt-exit-code at this step (first "
+                        "life only — a resumed process past this step runs on)")
+    parser.add_argument("--preempt-exit-code", type=int, default=143,
+                        help="143=SIGTERM, retryable per the exit-code "
+                        "classifier (train_util.go:18-53 analogue)")
     args = parser.parse_args(argv)
 
 
@@ -63,13 +74,33 @@ def main(argv=None) -> int:
     step = make_train_step(
         classification_loss_fn(model.apply, model_kwargs=model_kwargs)
     )
+    ckpt = None
+    start_step = 0
+    if args.checkpoint_dir:
+        from ..train.checkpoint import CheckpointManager
+
+        ckpt = CheckpointManager(args.checkpoint_dir)
+        latest = ckpt.latest_step()
+        if latest is not None:
+            state = ckpt.restore(state)
+            start_step = latest
+            print(f"resumed from checkpoint step {start_step}", flush=True)
+
     data = synthetic_mnist(args.batch, seed=ctx.replica_index)
     loss = float("inf")
-    for i in range(args.steps):
+    for i in range(start_step, args.steps):
         state, metrics = step(state, next(data))
         loss = float(metrics["loss"])
         if i % 10 == 0:
             print(f"step {i} loss {loss:.4f}", flush=True)
+        done = i + 1
+        if (ckpt is not None and args.preempt_at_step is not None
+                and start_step < args.preempt_at_step == done):
+            ckpt.save(state, step=done)
+            print(f"preempted at step {done}, checkpoint saved", flush=True)
+            return args.preempt_exit_code
+        if ckpt is not None and args.save_every and done % args.save_every == 0:
+            ckpt.save(state, step=done)
     print(f"final loss {loss:.4f}", flush=True)
     if args.target_loss is not None and loss > args.target_loss:
         print(f"target loss {args.target_loss} not reached", flush=True)
