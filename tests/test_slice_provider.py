@@ -1,0 +1,383 @@
+"""Slice-provider tests: slice-shaped atomic allocation + whole-slice preemption.
+
+SURVEY.md §4's closing lesson ("a fake slice provider standing in for the TPU
+allocation API") and §7's translation row (Volcano MinMember -> all-or-nothing
+slice allocation).  No reference analogue — the reference counts opaque GPU
+resources; here a multi-host slice is the atomic unit and preemption takes
+the whole slice.
+"""
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.types import ReplicaType, RestartPolicy, TPUTopology
+from tf_operator_tpu.controller.topology import gen_tpu_env
+from tf_operator_tpu.runtime.cluster import InMemoryCluster
+from tf_operator_tpu.runtime.scheduler import GangScheduler
+from tf_operator_tpu.runtime.slices import (
+    FakeSliceProvider,
+    SliceState,
+    parse_topology,
+    topology_chips,
+    topology_hosts,
+)
+
+from testutil import new_tpujob
+
+
+class TestTopologyMath:
+    def test_parse(self):
+        assert parse_topology("4x8") == (4, 8)
+        assert parse_topology("2x2x2") == (2, 2, 2)
+
+    def test_malformed(self):
+        for bad in ("", "4x", "x8", "ax4", "0x4"):
+            with pytest.raises(ValueError):
+                parse_topology(bad)
+
+    def test_chips_hosts(self):
+        assert topology_chips("4x8") == 32
+        assert topology_hosts("4x8") == 8  # 4 chips/host
+        assert topology_hosts("2x2") == 1  # single host
+        assert topology_hosts("2x4") == 2
+
+
+class TestFakeSliceProvider:
+    def test_atomic_allocation(self):
+        provider = FakeSliceProvider({("v5litepod-32", "4x8"): 2})
+        granted = provider.allocate("g1", "v5litepod-32", "4x8", 2)
+        assert granted is not None and len(granted) == 2
+        # nothing left: a third allocation is denied whole, not partial
+        assert provider.allocate("g2", "v5litepod-32", "4x8", 1) is None
+        provider.release("g1")
+        assert provider.allocate("g2", "v5litepod-32", "4x8", 1) is not None
+
+    def test_preemption_out_of_pool_until_repair(self):
+        provider = FakeSliceProvider({("v5litepod-16", "4x4"): 1})
+        (s,) = provider.allocate("g1", "v5litepod-16", "4x4", 1)
+        provider.inject_preemption(s.id)
+        provider.release("g1")
+        assert provider.allocate("g2", "v5litepod-16", "4x4", 1) is None
+        provider.repair(s.id)
+        assert provider.allocate("g2", "v5litepod-16", "4x4", 1) is not None
+
+    def test_watch_events(self):
+        provider = FakeSliceProvider({("v5litepod-16", "4x4"): 1})
+        seen = []
+        provider.watch(lambda s, e: seen.append((s.id, e)))
+        (s,) = provider.allocate("g1", "v5litepod-16", "4x4", 1)
+        provider.inject_preemption(s.id)
+        provider.repair(s.id)
+        assert seen == [(s.id, "preempted"), (s.id, "repaired")]
+
+
+def make_stack(inventory, restart_policy=RestartPolicy.NEVER):
+    from tf_operator_tpu.controller.controller import TPUJobController
+    from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+
+    cluster = InMemoryCluster()
+    controller = TPUJobController(
+        cluster, config=ReconcilerConfig(enable_gang_scheduling=True)
+    )
+    provider = FakeSliceProvider(inventory)
+    scheduler = GangScheduler(cluster, slice_provider=provider)
+    return cluster, controller, provider, scheduler
+
+
+def sliced_job(name, workers, accelerator="v5litepod-32", topology="4x8",
+               restart_policy=RestartPolicy.NEVER):
+    job = new_tpujob(worker=workers, name=name, restart_policy=restart_policy)
+    job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+        accelerator=accelerator, topology=topology
+    )
+    set_defaults(job)
+    return job
+
+
+def job_pods(cluster, name):
+    return sorted(
+        cluster.list_pods(selector={"job-name": name}),
+        key=lambda p: int(p.metadata.labels[constants.LABEL_REPLICA_INDEX]),
+    )
+
+
+def bound_pods(cluster, name):
+    return [
+        p for p in job_pods(cluster, name)
+        if p.metadata.annotations.get("tpu-operator.dev/bound") == "true"
+    ]
+
+
+def test_slice_assignment_host_ranks():
+    """8 workers on one v5e-32 (8 hosts): pod i -> host rank i of the slice."""
+    cluster, controller, provider, _ = make_stack({("v5litepod-32", "4x8"): 1})
+    job = sliced_job("slice-a", workers=8)
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    pods = job_pods(cluster, "slice-a")
+    assert len(pods) == 8
+    assert len(bound_pods(cluster, "slice-a")) == 8
+    slice_ids = {p.metadata.annotations[constants.ANNOTATION_SLICE_ID] for p in pods}
+    assert len(slice_ids) == 1
+    hosts = [int(p.metadata.annotations[constants.ANNOTATION_SLICE_HOST]) for p in pods]
+    assert hosts == list(range(8))
+
+
+def test_multislice_assignment_and_env():
+    """16 workers over two v5e-32 slices: slice id = index // hosts, and the
+    MEGASCALE_* DCN document is injected."""
+    cluster, controller, provider, _ = make_stack({("v5litepod-32", "4x8"): 2})
+    job = sliced_job("slice-m", workers=16)
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    pods = job_pods(cluster, "slice-m")
+    assert len(bound_pods(cluster, "slice-m")) == 16
+    per_slice = {}
+    for p in pods:
+        per_slice.setdefault(
+            p.metadata.annotations[constants.ANNOTATION_SLICE_ID], []
+        ).append(int(p.metadata.annotations[constants.ANNOTATION_SLICE_HOST]))
+    assert len(per_slice) == 2
+    for hosts in per_slice.values():
+        assert sorted(hosts) == list(range(8))
+
+    env0 = gen_tpu_env(job, ReplicaType.WORKER, 0)
+    env9 = gen_tpu_env(job, ReplicaType.WORKER, 9)
+    assert env0[constants.ENV_MEGASCALE_NUM_SLICES] == "2"
+    assert env0[constants.ENV_MEGASCALE_SLICE_ID] == "0"
+    assert env9[constants.ENV_MEGASCALE_SLICE_ID] == "1"
+    assert env0[constants.ENV_MEGASCALE_COORDINATOR] == \
+        env9[constants.ENV_MEGASCALE_COORDINATOR]
+    # single-slice jobs carry no DCN document
+    single = sliced_job("slice-s", workers=8)
+    assert constants.ENV_MEGASCALE_NUM_SLICES not in gen_tpu_env(
+        single, ReplicaType.WORKER, 0
+    )
+
+
+def test_second_gang_waits_for_slice():
+    cluster, controller, provider, _ = make_stack({("v5litepod-32", "4x8"): 1})
+    job_a = sliced_job("sl-a", workers=8)
+    job_b = sliced_job("sl-b", workers=8)
+    cluster.create_job(job_a)
+    controller.sync_job(job_a.key())
+    assert len(bound_pods(cluster, "sl-a")) == 8
+
+    cluster.create_job(job_b)
+    controller.sync_job(job_b.key())
+    assert bound_pods(cluster, "sl-b") == []
+    assert cluster.get_podgroup("default", "sl-b").phase == "Pending"
+
+    # job A succeeds -> cleanup deletes pods -> slice freed -> B admitted
+    for pod in cluster.list_pods(selector={"job-name": "sl-a"}):
+        cluster.set_pod_phase("default", pod.metadata.name, PodPhase.SUCCEEDED, exit_code=0)
+    controller.sync_job(job_a.key())
+    controller.sync_job(job_a.key())
+    assert len(bound_pods(cluster, "sl-b")) == 8
+    assert cluster.get_podgroup("default", "sl-b").phase == "Running"
+
+
+def test_slice_preemption_restart_and_repair():
+    """The §7 'hard part': preemption takes the whole slice; the gang
+    restarts as a unit and re-admits only after the fabric repairs."""
+    cluster, controller, provider, scheduler = make_stack(
+        {("v5litepod-16", "4x4"): 1}
+    )
+    job = sliced_job(
+        "pre-a", workers=4, accelerator="v5litepod-16", topology="4x4",
+        restart_policy=RestartPolicy.EXIT_CODE,
+    )
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    assert len(bound_pods(cluster, "pre-a")) == 4
+    slice_id = job_pods(cluster, "pre-a")[0].metadata.annotations[
+        constants.ANNOTATION_SLICE_ID
+    ]
+
+    provider.inject_preemption(slice_id)
+    # every pod on the slice died with the preemption signal
+    for pod in job_pods(cluster, "pre-a"):
+        assert pod.status.phase == PodPhase.FAILED
+        assert pod.status.container_statuses[0].exit_code == 143
+
+    # controller observes retryable exits -> JobRestarting + recreate
+    controller.sync_job(job.key())
+    job_now = cluster.get_job("default", "pre-a")
+    conditions = {c.type.value for c in job_now.status.conditions}
+    assert "Restarting" in conditions
+    controller.sync_job(job.key())
+    fresh = job_pods(cluster, "pre-a")
+    assert len(fresh) == 4
+    # but the only slice is still preempted: gang stays Pending
+    assert bound_pods(cluster, "pre-a") == []
+    assert cluster.get_podgroup("default", "pre-a").phase == "Pending"
+
+    provider.repair(slice_id)
+    assert len(bound_pods(cluster, "pre-a")) == 4
+    assert cluster.get_podgroup("default", "pre-a").phase == "Running"
+    states = {s.state for s in provider.list_slices()}
+    assert states == {SliceState.ALLOCATED}
+
+
+def test_mixed_gang_preemption_rebinds_after_repair():
+    """PS (plain) + sliced workers: slice preemption fails only the workers;
+    the gang stays admitted via the surviving PS, the recreated workers wait
+    for the repair, then re-bind (regression: the late-member path used to
+    bind sliced pods with no slice at all)."""
+    cluster, controller, provider, _ = make_stack({("v5litepod-16", "4x4"): 1})
+    job = new_tpujob(worker=4, ps=1, name="mix-a",
+                     restart_policy=RestartPolicy.EXIT_CODE)
+    job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+        accelerator="v5litepod-16", topology="4x4"
+    )
+    set_defaults(job)
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    workers = job_pods(cluster, "mix-a")
+    assert len(bound_pods(cluster, "mix-a")) == 5  # 4 workers + 1 ps
+    worker_pods = [p for p in workers
+                   if p.metadata.labels[constants.LABEL_REPLICA_TYPE] == "worker"]
+    slice_id = worker_pods[0].metadata.annotations[constants.ANNOTATION_SLICE_ID]
+    ps_pod = next(p for p in workers
+                  if p.metadata.labels[constants.LABEL_REPLICA_TYPE] == "ps")
+    assert constants.ANNOTATION_SLICE_ID not in ps_pod.metadata.annotations
+
+    provider.inject_preemption(slice_id)
+    failed = [p for p in job_pods(cluster, "mix-a")
+              if p.status.phase == PodPhase.FAILED]
+    assert len(failed) == 4  # only the slice hosts died, not the PS
+
+    controller.sync_job(job.key())  # restart deletes failed workers
+    controller.sync_job(job.key())  # recreates them
+    recreated = [p for p in job_pods(cluster, "mix-a")
+                 if p.metadata.labels[constants.LABEL_REPLICA_TYPE] == "worker"]
+    assert len(recreated) == 4
+    # slice still preempted: recreated workers must NOT be bound
+    assert all(p not in bound_pods(cluster, "mix-a") for p in recreated)
+
+    provider.repair(slice_id)
+    bound_workers = [
+        p for p in bound_pods(cluster, "mix-a")
+        if p.metadata.labels[constants.LABEL_REPLICA_TYPE] == "worker"
+    ]
+    assert len(bound_workers) == 4
+    hosts = sorted(
+        int(p.metadata.annotations[constants.ANNOTATION_SLICE_HOST])
+        for p in bound_workers
+    )
+    assert hosts == [0, 1, 2, 3]
+
+
+def test_elastic_scale_up_packs_free_host_slots():
+    """Growing a sliced worker group packs new pods into free host slots of
+    the held slice before allocating fresh slices."""
+    cluster, controller, provider, _ = make_stack({("v5litepod-32", "4x8"): 1})
+    job = sliced_job("ela-a", workers=4)
+    job.spec.enable_dynamic_worker = True
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    assert len(bound_pods(cluster, "ela-a")) == 4
+
+    job = cluster.get_job("default", "ela-a")
+    job.spec.replica_specs[ReplicaType.WORKER].replicas = 6
+    cluster.update_job(job)
+    controller.sync_job(job.key())
+    pods = job_pods(cluster, "ela-a")
+    assert len(pods) == 6
+    assert len(bound_pods(cluster, "ela-a")) == 6
+    # all six share the single held slice; ranks 0..5
+    assert len({p.metadata.annotations[constants.ANNOTATION_SLICE_ID]
+                for p in pods}) == 1
+    assert sorted(
+        int(p.metadata.annotations[constants.ANNOTATION_SLICE_HOST])
+        for p in pods
+    ) == list(range(6))
+
+
+def test_unsatisfiable_shape_warns():
+    """A shape absent from the fabric inventory surfaces a Warning event
+    instead of waiting Pending silently forever."""
+    cluster, controller, provider, _ = make_stack({("v5litepod-32", "4x8"): 1})
+    job = sliced_job("bad-a", workers=2, accelerator="v6e-64", topology="8x8")
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    assert bound_pods(cluster, "bad-a") == []
+    events = [e for e in cluster.list_events(object_name="bad-a")
+              if e.reason == "UnschedulableSliceShape"]
+    assert len(events) == 1
+    # case-normalized topologies DO match inventory
+    job2 = sliced_job("case-a", workers=8, topology="4X8")
+    cluster.create_job(job2)
+    controller.sync_job(job2.key())
+    assert len(bound_pods(cluster, "case-a")) == 8
+
+
+def test_multislice_multi_type_rejected():
+    """Slice topologies on >1 JAX-process replica type are rejected when the
+    job is multislice — one jax.distributed group cannot carry two
+    inconsistent MEGASCALE documents."""
+    from tf_operator_tpu.api.validation import ValidationError, validate
+
+    job = new_tpujob(worker=16, chief=1, name="mt-a")
+    job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+        accelerator="v5litepod-32", topology="4x8"
+    )
+    job.spec.replica_specs[ReplicaType.CHIEF].tpu = TPUTopology(
+        accelerator="v5litepod-32", topology="4x8"
+    )
+    set_defaults(job)
+    with pytest.raises(ValidationError, match="multislice"):
+        validate(job)
+    # and the topology injector emits no MEGASCALE doc for such a spec
+    assert constants.ENV_MEGASCALE_NUM_SLICES not in gen_tpu_env(
+        job, ReplicaType.WORKER, 9
+    )
+
+    # single-slice jobs may spread topologies over types (no DCN document)
+    job2 = new_tpujob(worker=4, chief=1, name="mt-b")
+    job2.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+        accelerator="v5litepod-16", topology="4x4"
+    )
+    job2.spec.replica_specs[ReplicaType.CHIEF].tpu = TPUTopology(
+        accelerator="v5litepod-16", topology="4x4"
+    )
+    set_defaults(job2)
+    validate(job2)
+
+
+def test_partial_preemption_does_not_double_book_healthy_slices():
+    """Preempting one slice of a two-slice gang must NOT free the healthy
+    slice to other gangs while the gang's pods still run on it (regression:
+    eager release double-booked the surviving slice)."""
+    cluster, controller, provider, _ = make_stack({("v5litepod-32", "4x8"): 2})
+    job_a = sliced_job("dbl-a", workers=16, restart_policy=RestartPolicy.EXIT_CODE)
+    job_b = sliced_job("dbl-b", workers=8)
+    cluster.create_job(job_a)
+    controller.sync_job(job_a.key())
+    assert len(bound_pods(cluster, "dbl-a")) == 16
+    cluster.create_job(job_b)
+    controller.sync_job(job_b.key())
+    assert bound_pods(cluster, "dbl-b") == []
+
+    pods = job_pods(cluster, "dbl-a")
+    slice0 = pods[0].metadata.annotations[constants.ANNOTATION_SLICE_ID]
+    provider.inject_preemption(slice0)
+    # only slice-0 hosts died; the healthy slice is still gang A's
+    failed = [p for p in job_pods(cluster, "dbl-a")
+              if p.status.phase == PodPhase.FAILED]
+    assert len(failed) == 8
+    assert all(
+        p.metadata.annotations[constants.ANNOTATION_SLICE_ID] == slice0
+        for p in failed
+    )
+    assert bound_pods(cluster, "dbl-b") == []  # nothing freed yet
+
+    # controller gang-restarts A: all pods deleted, reservation released;
+    # with one slice preempted only B's single-slice gang fits.
+    controller.sync_job(job_a.key())
+    controller.sync_job(job_a.key())
+    assert len(bound_pods(cluster, "dbl-b")) == 8
+    assert bound_pods(cluster, "dbl-a") == []  # waits for repair
+    provider.repair(slice0)
+    assert cluster.get_podgroup("default", "dbl-b").phase == "Running"

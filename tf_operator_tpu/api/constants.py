@@ -39,6 +39,15 @@ JOB_ROLE_MASTER = "master"
 GANG_SCHEDULER_NAME = "tpu-gang"
 GANG_GROUP_ANNOTATION = "scheduling.tpu-operator.dev/group-name"
 
+# --- Slice allocation annotations (no reference analogue: GPU pods are
+# placed individually; TPU slices are allocated whole).  The reconciler
+# stamps accelerator/topology from the replica's tpu block; the gang
+# scheduler writes slice id + host rank back at admission.
+ANNOTATION_ACCELERATOR = "tpu-operator.dev/accelerator"
+ANNOTATION_SLICE_TOPOLOGY = "tpu-operator.dev/slice-topology"
+ANNOTATION_SLICE_ID = "tpu-operator.dev/slice-id"
+ANNOTATION_SLICE_HOST = "tpu-operator.dev/slice-host"
+
 # --- Environment variables the controller injects into pods ---
 # TF_CONFIG is kept byte-compatible with the reference
 # (ref: pkg/controller.v1/tensorflow/tensorflow.go:39-61).
@@ -53,6 +62,11 @@ ENV_SLICE_TOPOLOGY = "TPUJOB_SLICE_TOPOLOGY"  # e.g. "2x4" chips
 ENV_ACCELERATOR = "TPUJOB_ACCELERATOR"  # e.g. "v5litepod-8"
 ENV_REPLICA_TYPE = "TPUJOB_REPLICA_TYPE"
 ENV_REPLICA_INDEX = "TPUJOB_REPLICA_INDEX"
+# Multi-slice (DCN) coordination env, emitted when one replica group spans
+# more than one slice — the names JAX/libtpu multislice reads.
+ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"
+ENV_MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+ENV_MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
 # Override for the cluster DNS domain appended to service addresses
 # (ref: pkg/controller.v1/tensorflow/tensorflow.go:30-33,160-163).
 ENV_CUSTOM_CLUSTER_DOMAIN = "CUSTOM_CLUSTER_DOMAIN"

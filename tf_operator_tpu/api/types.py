@@ -20,6 +20,38 @@ from typing import Dict, List, Optional
 
 from .core import ObjectMeta, PodTemplateSpec
 
+# ---------------------------------------------------------------------------
+# Slice topology math — schema-level (the spec strings "4x8"/"2x2x2" are part
+# of the API), shared by validation, defaults, and the runtime slice
+# allocator (runtime/slices.py re-exports these).
+
+# A host of a TPU pod slice carries 4 chips (v4: 2x2x1 per host; v5e/v5p:
+# 4 chips/host).  Topologies with <=4 chips fit on one host.
+CHIPS_PER_HOST = 4
+
+
+def parse_topology(topology: str) -> tuple:
+    """'4x8' -> (4, 8); '2x2x2' -> (2, 2, 2).  Raises ValueError on junk."""
+    try:
+        dims = tuple(int(d) for d in topology.lower().split("x"))
+    except ValueError:
+        raise ValueError(f"malformed slice topology {topology!r}")
+    if not dims or any(d <= 0 for d in dims):
+        raise ValueError(f"malformed slice topology {topology!r}")
+    return dims
+
+
+def topology_chips(topology: str) -> int:
+    chips = 1
+    for d in parse_topology(topology):
+        chips *= d
+    return chips
+
+
+def topology_hosts(topology: str) -> int:
+    """Hosts (= worker processes) a slice of this shape spans."""
+    return max(1, -(-topology_chips(topology) // CHIPS_PER_HOST))
+
 
 class ReplicaType(str, Enum):
     """Replica roles (ref: pkg/apis/tensorflow/v1/types.go:73-92).
@@ -159,12 +191,7 @@ class TPUTopology:
     mesh: Dict[str, int] = field(default_factory=dict)
 
     def num_chips(self) -> int:
-        if not self.topology:
-            return 0
-        n = 1
-        for part in self.topology.lower().split("x"):
-            n *= int(part)
-        return n
+        return topology_chips(self.topology) if self.topology else 0
 
 
 @dataclass

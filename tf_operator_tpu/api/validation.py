@@ -49,6 +49,38 @@ def validate_spec(spec: TPUJobSpec) -> None:
 
     _validate_singleton(spec, (ReplicaType.CHIEF, ReplicaType.MASTER), "chief/master")
     _validate_singleton(spec, (ReplicaType.EVALUATOR,), "evaluator")
+    _validate_multislice(spec)
+
+
+def _validate_multislice(spec: TPUJobSpec) -> None:
+    """A multislice group (replicas spanning >1 slice) must be the job's only
+    JAX-process replica type carrying a slice topology: all accelerator
+    processes share one jax.distributed group, and a MEGASCALE document that
+    differs across the group (or is absent for some members) hangs libtpu
+    multislice init (controller/topology.py:_add_multislice_env)."""
+    from .types import topology_hosts
+
+    sliced_jax_types = []
+    multislice = False
+    for key, rspec in spec.replica_specs.items():
+        rtype = normalize_replica_type(key)
+        if rtype not in (ReplicaType.CHIEF, ReplicaType.MASTER, ReplicaType.WORKER):
+            continue
+        if rspec is None or rspec.tpu is None or not rspec.tpu.topology:
+            continue
+        sliced_jax_types.append(rtype)
+        try:
+            hosts = topology_hosts(rspec.tpu.topology)
+        except ValueError:
+            continue  # malformed topology is reported by _validate_replica
+        if int(rspec.replicas or 1) > hosts:
+            multislice = True
+    if multislice and len(sliced_jax_types) > 1:
+        names = ", ".join(rt.value for rt in sliced_jax_types)
+        raise ValidationError(
+            "TPUJobSpec is not valid: a multislice job must keep all its "
+            f"accelerator processes in one replica type, found topologies on {names}"
+        )
 
 
 def _validate_singleton(spec: TPUJobSpec, rtypes, label: str) -> None:

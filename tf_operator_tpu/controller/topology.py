@@ -158,7 +158,62 @@ def gen_tpu_env(
             env[constants.ENV_MESH_SHAPE] = json.dumps(
                 rspec.tpu.mesh, separators=(",", ":")
             )
+        _add_multislice_env(env, job, rtype, rspec, index, resolver)
     return env
+
+
+def _add_multislice_env(
+    env: Dict[str, str],
+    job: TPUJob,
+    rtype: ReplicaType,
+    rspec,
+    index: int,
+    resolver: AddressResolver,
+) -> None:
+    """DCN multislice coordination (no reference analogue; SURVEY §7's
+    'across slices/DCN, emit coordinator addresses').
+
+    One replica == one slice host (runtime/slices.py packing), so a group
+    whose replica count exceeds one slice's host count spans several slices
+    wired over DCN.  The scheduler packs slices per replica type in replica-
+    index order, so `index // hosts` here names exactly the slice the pod
+    lands on.  A multislice job must keep all its accelerator processes in
+    one replica type — api/validation.py rejects multislice specs that
+    spread slice topologies over several JAX process types, and this
+    function emits nothing for them (an inconsistent MEGASCALE document
+    across one jax.distributed group hangs libtpu init).  Emit the
+    MEGASCALE_* document JAX/libtpu multislice reads: a single coordinator
+    (slice 0, host 0) plus this process's slice id.  Within a slice,
+    workers still find each other over ICI — only the cross-slice layer
+    needs addresses, exactly the reference's TF_CONFIG division of labor
+    re-drawn at the slice boundary.
+    """
+    import math
+
+    from ..api.types import topology_hosts
+
+    if not rspec.tpu.topology:
+        return
+    sliced_jax_types = [
+        rt for rt in _JAX_PROCESS_TYPES
+        if job.spec.replica_specs.get(rt) is not None
+        and job.spec.replica_specs[rt].tpu is not None
+        and job.spec.replica_specs[rt].tpu.topology
+    ]
+    if len(sliced_jax_types) > 1:
+        return
+    try:
+        hosts = topology_hosts(rspec.tpu.topology)
+    except ValueError:
+        return
+    replicas = int(rspec.replicas or 0)
+    num_slices = max(1, math.ceil(replicas / hosts))
+    if num_slices < 2:
+        return
+    port = get_port_from_job(job.spec, rtype)
+    env[constants.ENV_MEGASCALE_COORDINATOR] = resolver(job, rtype, 0, port)
+    env[constants.ENV_MEGASCALE_NUM_SLICES] = str(num_slices)
+    env[constants.ENV_MEGASCALE_SLICE_ID] = str(index // hosts)
 
 
 def set_cluster_spec(

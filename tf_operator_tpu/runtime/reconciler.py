@@ -458,6 +458,15 @@ class JobReconciler:
                     log.info("gang restart: deleting sibling pod %s", pod.metadata.name)
                     delete(pod)
                     metrics.restarted_pods.labels().inc()
+                    if pod.status.phase == PodPhase.RUNNING:
+                        # A deleted sibling is not active: leaving it counted
+                        # would let the status engine set Running this pass,
+                        # whose mutual-exclusion filter erases the Restarting
+                        # condition just recorded (ref: status.go:168-180
+                        # Running<->Restarting exclusion).
+                        rs = job.status.replica_statuses.get(rtype.value)
+                        if rs is not None and rs.active > 0:
+                            rs.active -= 1
         return restarted
 
     def create_new_pod(
@@ -505,6 +514,15 @@ class JobReconciler:
             if self.config.gang_mechanism != "pdb" and not pod.spec.scheduler_name:
                 pod.spec.scheduler_name = self.config.gang_scheduler_name
             pod.metadata.annotations[constants.GANG_GROUP_ANNOTATION] = job.metadata.name
+        if rspec.tpu is not None and rspec.tpu.topology:
+            # Slice shape for the scheduler's slice-shaped admission
+            # (runtime/slices.py); slice id/host written back at admission.
+            pod.metadata.annotations.setdefault(
+                constants.ANNOTATION_ACCELERATOR, rspec.tpu.accelerator
+            )
+            pod.metadata.annotations.setdefault(
+                constants.ANNOTATION_SLICE_TOPOLOGY, rspec.tpu.topology
+            )
 
         try:
             self.pod_control.create_pod(pod, job)

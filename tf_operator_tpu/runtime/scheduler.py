@@ -6,27 +6,60 @@ enforce them.  Our substrates are the cluster, so this module enforces them:
 pods stamped with the gang scheduler name are held unbound (Pending) until
 
   1. the whole gang is present (count >= PodGroup.min_member), and
-  2. the slice pool has capacity for the gang's total chip request
+  2. the fabric has capacity for the gang — whole slices for slice-shaped
+     replicas (via the SliceProvider), chip counts for plain ones
 
 — then every member binds atomically.  A partial TPU slice is useless, so
 admission is all-or-nothing by construction; capacity is released when gang
 pods are deleted.
 
+Reservations are gang-lifetime: once admitted, a gang keeps its chips and
+slices until every member departs.  Restarted pods (deterministic names)
+reclaim their original slice host slot; elastic growth packs new pods into
+free host slots of held slices before allocating fresh slices.
+
 The pool models the driver-visible fabric (e.g. one v5e-32 = 32 chips).
 `google.com/tpu` container requests (injected by defaults from the replica's
-topology block) are the unit of accounting.
+topology block) are the unit of accounting for plain pods.
 """
 from __future__ import annotations
 
+import math
 import threading
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..api import constants
-from ..api.core import Pod
+from ..api.core import Event, Pod
 from ..utils import logging as tpulog
 from .cluster import ClusterInterface, EventType, NotFound
+from .slices import (
+    Slice,
+    SliceProvider,
+    SliceState,
+    normalize_topology,
+    topology_hosts,
+)
 
 log = tpulog.logger_for_key("gang-scheduler")
+
+# pod name -> (namespace, slice id, host rank)
+SlotMap = Dict[str, Tuple[str, str, int]]
+
+
+def _pod_replica_order(pod: Pod):
+    idx = pod.metadata.labels.get(constants.LABEL_REPLICA_INDEX)
+    try:
+        return (0, int(idx), pod.metadata.name)
+    except (TypeError, ValueError):
+        return (1, 0, pod.metadata.name)
+
+
+def _pod_shape(pod: Pod) -> Tuple[str, str, str]:
+    return (
+        pod.metadata.labels.get(constants.LABEL_REPLICA_TYPE, ""),
+        pod.metadata.annotations.get(constants.ANNOTATION_ACCELERATOR, ""),
+        pod.metadata.annotations[constants.ANNOTATION_SLICE_TOPOLOGY],
+    )
 
 
 def pod_chip_request(pod: Pod) -> float:
@@ -66,19 +99,30 @@ class GangScheduler:
 
     def __init__(self, cluster: ClusterInterface,
                  total_chips: Optional[float] = None,
-                 scheduler_name: str = constants.GANG_SCHEDULER_NAME) -> None:
+                 scheduler_name: str = constants.GANG_SCHEDULER_NAME,
+                 slice_provider: Optional[SliceProvider] = None) -> None:
         self.cluster = cluster
         self.pool = SlicePool(total_chips)
         self.scheduler_name = scheduler_name
+        self.slice_provider = slice_provider
         self._lock = threading.Lock()
         # group key -> reserved chips (admitted gangs)
         self._admitted: Dict[str, float] = {}
         # group key -> member pod names currently existing
         self._members: Dict[str, Set[str]] = {}
+        # group key -> slice slot per pod NAME — name-keyed so a restarted
+        # pod (deterministic name) reclaims its slice host.  Recorded under
+        # the lock at allocation time so preemption handling never depends
+        # on annotation writes that happen after the lock is dropped.
+        self._slots: Dict[str, SlotMap] = {}
+        # (group key, shape) already warned unsatisfiable
+        self._warned: Set[tuple] = set()
         register = getattr(cluster, "register_gang_scheduler", None)
         if register is not None:
             register(scheduler_name)
         cluster.watch_pods(self._on_pod_event)
+        if slice_provider is not None:
+            slice_provider.watch(self._on_slice_event)
 
     @staticmethod
     def _group_key(pod: Pod) -> Optional[str]:
@@ -113,12 +157,22 @@ class GangScheduler:
             if members is not None:
                 members.discard(pod.metadata.name)
                 if not members:
-                    # Gang fully gone: release its reservation.
+                    # Gang fully gone: release its reservation.  A partial
+                    # departure keeps everything — the slot map retains the
+                    # pod's slice host so its restarted namesake reclaims it.
                     chips = self._admitted.pop(key, None)
                     self._members.pop(key, None)
+                    self._slots.pop(key, None)
                     if chips:
                         self.pool.release(chips)
                         log.info("released %.0f chips from gang %s", chips, key)
+                    # Provider release stays under the lock (ordering
+                    # scheduler->provider, same as _allocate_slices): doing
+                    # it after dropping the lock races a concurrent
+                    # re-admission of the same gang and would free slices
+                    # the new incarnation just allocated.
+                    if chips is not None and self.slice_provider is not None:
+                        self.slice_provider.release(key)
         # Capacity may have freed: retry other waiting gangs.
         self._retry_waiting()
 
@@ -136,18 +190,24 @@ class GangScheduler:
             and p.status.phase not in (PodPhase.SUCCEEDED, PodPhase.FAILED)
         ]
         unbound = [p for p in pods if not self._is_bound(p)]
+        with self._lock:
+            admitted = key in self._admitted
+        if admitted:
+            self._assign_late(key, unbound)
+            return
         # Atomic check-admit section: the already-admitted check, the chip
         # reservation, and the admitted record must not interleave with a
         # concurrent _try_admit for the same gang (double-reserve would leak
         # pool capacity permanently).
+        assignment: List[tuple] = []
         with self._lock:
             if key in self._admitted:
-                admit_late_only = True
+                assignment = None  # lost the race; another thread admitted
             else:
-                admit_late_only = False
                 if len(pods) < podgroup.min_member:
                     return
-                chips = sum(pod_chip_request(p) for p in pods)
+                sliced, plain = self._partition_sliced(pods)
+                chips = sum(pod_chip_request(p) for p in plain)
                 if not self.pool.try_reserve(chips):
                     log.info(
                         "gang %s waiting: %.0f chips requested, %.0f/%s in use",
@@ -155,17 +215,252 @@ class GangScheduler:
                     )
                     podgroup.phase = "Pending"
                     return
+                granted = self._allocate_slices(key, sliced)
+                if granted is None:
+                    # Slice shapes unavailable: whole gang stays Pending —
+                    # a partial slice set is as useless as a partial gang.
+                    self.pool.release(chips)
+                    podgroup.phase = "Pending"
+                    self._warn_unsatisfiable(key, namespace, group_name, sliced)
+                    return
+                assignment = granted
                 self._admitted[key] = chips
-        if admit_late_only:
-            # Late members of an admitted gang (e.g. a restarted pod) bind
-            # immediately — the reservation is gang-lifetime.
-            for pod in unbound:
-                self._bind(pod)
+        if assignment is None:
+            self._assign_late(key, unbound)
             return
+        # Annotation writes dispatch watch events, so they happen unlocked.
+        self._apply_slice_assignment(assignment)
         podgroup.phase = "Running"
         log.info("admitting gang %s (%d pods, %.0f chips)", key, len(pods), chips)
         for pod in unbound:
             self._bind(pod)
+
+    # ------------------------------------------------------------------
+    # slice-shaped allocation (runtime/slices.py; no reference analogue)
+
+    def _partition_sliced(self, pods: List[Pod]) -> tuple:
+        """Split gang members into slice-shaped ones (annotated with an
+        accelerator topology, allocated through the SliceProvider) and plain
+        chip-counted ones (the reference's opaque-resource model)."""
+        if self.slice_provider is None:
+            return [], list(pods)
+        sliced: List[Pod] = []
+        plain: List[Pod] = []
+        for p in pods:
+            if p.metadata.annotations.get(constants.ANNOTATION_SLICE_TOPOLOGY):
+                sliced.append(p)
+            else:
+                plain.append(p)
+        return sliced, plain
+
+    def _allocate_slices(self, key: str, sliced: List[Pod]):
+        """All-or-nothing slice allocation for the gang's sliced members.
+
+        Returns the pod->slice assignment [(pod, slice_id, host_rank)] or
+        None if any shape is unavailable (everything granted is rolled back).
+        One pod == one slice host; pods are grouped per replica type (so the
+        packing agrees with the per-type MEGASCALE document the topology
+        injector emits) and packed in replica-index order so host ranks
+        match process ids.  Caller holds self._lock.
+        """
+        if not sliced:
+            return []
+        groups: Dict[tuple, List[Pod]] = {}
+        for pod in sliced:
+            groups.setdefault(_pod_shape(pod), []).append(pod)
+        assignment: List[tuple] = []
+        slots: SlotMap = {}
+        for (_rtype, accelerator, topology), members in sorted(groups.items()):
+            hosts = topology_hosts(topology)
+            count = math.ceil(len(members) / hosts)
+            granted = self.slice_provider.allocate(key, accelerator, topology, count)
+            if granted is None:
+                self.slice_provider.release(key)
+                log.info(
+                    "gang %s waiting: %d x %s/%s slice(s) unavailable",
+                    key, count, accelerator, topology,
+                )
+                return None
+            members.sort(key=_pod_replica_order)
+            for i, pod in enumerate(members):
+                slc = granted[i // hosts]
+                assignment.append((pod, slc.id, i % hosts))
+                slots[pod.metadata.name] = (
+                    pod.metadata.namespace, slc.id, i % hosts
+                )
+        self._slots[key] = slots
+        return assignment
+
+    def _assign_late(self, key: str, unbound: List[Pod]) -> None:
+        """Bind late members of an admitted gang — the reservation is
+        gang-lifetime.  Plain pods bind against the held chip reservation.
+        A sliced pod reclaims its name-keyed slot (a restarted pod returns
+        to its slice host); a new name (elastic growth) packs into a free
+        host slot of a held slice, allocating fresh slices when none fit.
+        Pods whose slice is preempted, or whose shape is unavailable, stay
+        Pending — a repair or any departure retries them."""
+        assignment: List[tuple] = []
+        bind_plain: List[Pod] = []
+        with self._lock:
+            slots = self._slots.setdefault(key, {})
+            fresh: Dict[tuple, List[Pod]] = {}
+            for pod in unbound:
+                topo = pod.metadata.annotations.get(
+                    constants.ANNOTATION_SLICE_TOPOLOGY
+                )
+                if self.slice_provider is None or not topo:
+                    bind_plain.append(pod)
+                    continue
+                name = pod.metadata.name
+                slot = slots.get(name)
+                if slot is not None:
+                    _ns, slice_id, rank = slot
+                    slc = self.slice_provider.get_slice(slice_id)
+                    if (slc is not None and slc.holder == key
+                            and slc.state == SliceState.ALLOCATED):
+                        assignment.append((pod, slice_id, rank))
+                        continue
+                    if (slc is not None and slc.holder == key
+                            and slc.state == SliceState.PREEMPTED):
+                        continue  # wait for repair
+                    del slots[name]  # stale: slice repaired/released/gone
+                fresh.setdefault(_pod_shape(pod), []).append(pod)
+            for (_rtype, accelerator, topology), members in sorted(fresh.items()):
+                hosts = topology_hosts(topology)
+                topo_norm = normalize_topology(topology)
+                # Free host slots on held slices of this shape.
+                used_ranks: Dict[str, Set[int]] = {}
+                for _ns, sid, rank in slots.values():
+                    used_ranks.setdefault(sid, set()).add(rank)
+                open_slots: List[tuple] = []
+                seen_sids: Set[str] = set()
+                for _ns, sid, _rank in list(slots.values()):
+                    if sid in seen_sids:
+                        continue
+                    seen_sids.add(sid)
+                    slc = self.slice_provider.get_slice(sid)
+                    if (slc is None or slc.holder != key
+                            or slc.state != SliceState.ALLOCATED
+                            or slc.accelerator != accelerator
+                            or slc.topology != topo_norm):
+                        continue
+                    open_slots.extend(
+                        (sid, r) for r in range(slc.hosts)
+                        if r not in used_ranks.get(sid, set())
+                    )
+                open_slots.sort()
+                need = len(members) - len(open_slots)
+                if need > 0:
+                    count = math.ceil(need / hosts)
+                    granted = self.slice_provider.allocate(
+                        key, accelerator, topology, count
+                    )
+                    if granted is None:
+                        log.info(
+                            "gang %s late members waiting: %d x %s/%s "
+                            "slice(s) unavailable", key, count, accelerator,
+                            topology,
+                        )
+                        continue  # these pods stay Pending
+                    open_slots.extend(
+                        (s.id, r) for s in granted for r in range(s.hosts)
+                    )
+                members.sort(key=_pod_replica_order)
+                for pod, (sid, rank) in zip(members, open_slots):
+                    assignment.append((pod, sid, rank))
+                    slots[pod.metadata.name] = (
+                        pod.metadata.namespace, sid, rank
+                    )
+        self._apply_slice_assignment(assignment)
+        for pod in bind_plain:
+            self._bind(pod)
+        for pod, _sid, _rank in assignment:
+            self._bind(pod)
+
+    def _warn_unsatisfiable(self, key: str, namespace: str, group_name: str,
+                            sliced: List[Pod]) -> None:
+        """Surface 'this shape can NEVER be satisfied' (vs transient
+        capacity waits) as a Warning event on the job.  Caller holds the
+        lock; record_event is safe there (no re-entrant pod watch)."""
+        for pod in sliced:
+            _rtype, accelerator, topology = _pod_shape(pod)
+            if self.slice_provider.has_shape(accelerator, topology):
+                continue
+            mark = (key, accelerator, normalize_topology(topology))
+            if mark in self._warned:
+                continue
+            self._warned.add(mark)
+            self.cluster.record_event(Event(
+                object_kind="TPUJob",
+                object_name=group_name,
+                namespace=namespace,
+                event_type="Warning",
+                reason="UnschedulableSliceShape",
+                message=(
+                    f"no slice of shape {accelerator}/{topology} exists in "
+                    "the fabric inventory; the gang cannot be admitted"
+                ),
+            ))
+
+    def _apply_slice_assignment(self, assignment: List[tuple]) -> None:
+        for pod, slice_id, host_rank in assignment:
+            pod.metadata.annotations[constants.ANNOTATION_SLICE_ID] = slice_id
+            pod.metadata.annotations[constants.ANNOTATION_SLICE_HOST] = str(host_rank)
+            try:
+                self.cluster.update_pod(pod)
+            except NotFound:
+                pass  # deleted while admitting; departure handling reconciles
+
+    def _on_slice_event(self, slc: Slice, event: str) -> None:
+        """Fabric notifications: whole-slice preemption and repair."""
+        if event == "repaired":
+            self._retry_waiting()
+            return
+        if event != "preempted" or slc.holder is None:
+            return
+        key = slc.holder
+        # Only the pods on the dead slice are failed here; the gang's
+        # reservation (including its healthy slices) stays in place until the
+        # pods depart — the controller's gang-restart deletes them, the
+        # departure path releases everything, and re-admission re-allocates
+        # (the preempted slice is out of the pool until repaired).  Releasing
+        # eagerly would double-book the healthy slices under live pods.
+        # The victim set comes from the slot map written under the admission
+        # lock, not from annotations — annotation writes happen after the
+        # lock is dropped, so a preemption racing admission would otherwise
+        # find nothing to fail.
+        with self._lock:
+            victims = [
+                (ns, name)
+                for name, (ns, sid, _rank) in self._slots.get(key, {}).items()
+                if sid == slc.id
+            ]
+        log.info("slice %s preempted: failing %d pod(s) of gang %s on it",
+                 slc.id, len(victims), key)
+        # Pods on the dead slice terminate with SIGTERM's code (143) — the
+        # retryable preemption signal (runtime/exit_codes.py); the
+        # controller's gang-restart machinery does the rest.
+        from ..api.core import ContainerStatus, PodPhase
+
+        for namespace, name in victims:
+            try:
+                pod = self.cluster.get_pod(namespace, name)
+            except NotFound:
+                continue
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            pod.status.phase = PodPhase.FAILED
+            pod.status.reason = "SlicePreempted"
+            pod.status.message = f"TPU slice {slc.id} was preempted"
+            names = [c.name for c in pod.spec.containers] or ["tensorflow"]
+            pod.status.container_statuses = [
+                ContainerStatus(name=n, terminated=True, exit_code=143)
+                for n in names
+            ]
+            try:
+                self.cluster.update_pod(pod)
+            except NotFound:
+                continue
 
     @staticmethod
     def _is_bound(pod: Pod) -> bool:
@@ -174,17 +469,22 @@ class GangScheduler:
     def _bind(self, pod: Pod) -> None:
         binder = getattr(self.cluster, "bind_pod", None)
         if binder is not None:
-            binder(pod.metadata.namespace, pod.metadata.name)
+            try:
+                binder(pod.metadata.namespace, pod.metadata.name)
+            except NotFound:
+                pass  # deleted between admission snapshot and bind
 
     def _retry_waiting(self) -> None:
+        """Retry admission for every gang with unbound pods — waiting gangs
+        get a full admission attempt; admitted gangs get their Pending late
+        members (re)assigned (e.g. after a slice repair)."""
         namespaces = {}
         for pod in self.cluster.list_pods():
             key = self._group_key(pod)
             if key is None or pod.spec.scheduler_name != self.scheduler_name:
                 continue
-            with self._lock:
-                if key in self._admitted:
-                    continue
+            if self._is_bound(pod):
+                continue
             namespaces[key] = pod.metadata.namespace
         for key, namespace in namespaces.items():
             self._try_admit(key, namespace)

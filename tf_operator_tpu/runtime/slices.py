@@ -1,0 +1,202 @@
+"""TPU slice provider: the slice-allocation API boundary.
+
+The reference has no analogue — it expresses accelerators as an opaque
+`nvidia.com/gpu` resource request and trusts the k8s scheduler + Volcano to
+place pods (SURVEY.md §2.9 table).  TPU pod slices are structurally
+different: a multi-host slice (e.g. v5e "4x8" = 32 chips over 8 hosts) is
+provisioned atomically, every host of the slice runs exactly one worker
+process, and preemption takes the WHOLE slice — a half-allocated or
+half-preempted slice is useless because the ICI torus is broken.
+
+This module is the seam SURVEY.md §4 closes with ("a fake slice provider
+standing in for the TPU allocation API"): `SliceProvider` is the interface
+the gang scheduler allocates through, `FakeSliceProvider` is the hermetic,
+deterministic test double with preemption injection, and a real deployment
+would back the same interface with the Cloud TPU API / node pools.
+
+Shape matching is case-insensitive on the topology (the schema validator
+lowercases too) so a spec written "4X8" finds a "4x8" inventory entry.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+# Topology math lives at the api layer (the "4x8" strings are schema);
+# re-exported here for runtime callers.
+from ..api.types import (  # noqa: F401  (re-exports)
+    CHIPS_PER_HOST,
+    parse_topology,
+    topology_chips,
+    topology_hosts,
+)
+from ..utils import logging as tpulog
+
+log = tpulog.logger_for_key("slice-provider")
+
+
+def normalize_topology(topology: str) -> str:
+    return topology.lower().strip()
+
+
+class SliceState:
+    FREE = "Free"
+    ALLOCATED = "Allocated"
+    PREEMPTED = "Preempted"
+
+
+class Slice:
+    """One atomic slice of the fabric."""
+
+    def __init__(self, slice_id: str, accelerator: str, topology: str) -> None:
+        self.id = slice_id
+        self.accelerator = accelerator
+        self.topology = normalize_topology(topology)
+        self.chips = topology_chips(topology)
+        self.hosts = topology_hosts(topology)
+        self.state = SliceState.FREE
+        self.holder: Optional[str] = None  # gang key while allocated
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (f"Slice({self.id}, {self.accelerator}/{self.topology}, "
+                f"{self.state}, holder={self.holder})")
+
+
+# callback(slice, event) with event in {"preempted", "repaired"}
+SliceWatchHandler = Callable[[Slice, str], None]
+
+
+class SliceProvider:
+    """The allocation API.  All-or-nothing by contract: `allocate` either
+    returns `count` slices of the requested shape or None (never partial)."""
+
+    def allocate(self, holder: str, accelerator: str, topology: str,
+                 count: int) -> Optional[List[Slice]]:
+        raise NotImplementedError
+
+    def release(self, holder: str) -> None:
+        """Return every slice held by `holder` to the pool."""
+        raise NotImplementedError
+
+    def has_shape(self, accelerator: str, topology: str) -> bool:
+        """Whether the fabric contains ANY slice of this shape (in any
+        state) — lets the scheduler distinguish 'wait for capacity' from
+        'this request can never be satisfied'."""
+        raise NotImplementedError
+
+    def get_slice(self, slice_id: str) -> Optional[Slice]:
+        raise NotImplementedError
+
+    def list_slices(self) -> List[Slice]:
+        raise NotImplementedError
+
+    def watch(self, handler: SliceWatchHandler) -> None:
+        raise NotImplementedError
+
+
+class FakeSliceProvider(SliceProvider):
+    """Deterministic in-memory inventory of slices, with fault injection.
+
+    inventory: {(accelerator, topology): count}, e.g.
+    {("v5litepod-32", "4x8"): 2} models a reservation of two v5e-32 slices.
+    """
+
+    def __init__(self, inventory: Dict[Tuple[str, str], int]) -> None:
+        self._slices: List[Slice] = []
+        for (accelerator, topology), count in sorted(inventory.items()):
+            for i in range(count):
+                self._slices.append(
+                    Slice(f"{accelerator}-{normalize_topology(topology)}-{i}",
+                          accelerator, topology)
+                )
+        self._lock = threading.Lock()
+        self._watchers: List[SliceWatchHandler] = []
+
+    # -- SliceProvider --
+
+    def allocate(self, holder: str, accelerator: str, topology: str,
+                 count: int) -> Optional[List[Slice]]:
+        topology = normalize_topology(topology)
+        with self._lock:
+            free = [
+                s for s in self._slices
+                if s.state == SliceState.FREE
+                and s.accelerator == accelerator and s.topology == topology
+            ]
+            if len(free) < count:
+                log.info(
+                    "allocation for %s denied: want %d x %s/%s, %d free",
+                    holder, count, accelerator, topology, len(free),
+                )
+                return None
+            granted = free[:count]
+            for s in granted:
+                s.state = SliceState.ALLOCATED
+                s.holder = holder
+            return list(granted)
+
+    def release(self, holder: str) -> None:
+        with self._lock:
+            for s in self._slices:
+                if s.holder == holder:
+                    s.holder = None
+                    # A preempted slice stays out of the pool until repaired.
+                    if s.state == SliceState.ALLOCATED:
+                        s.state = SliceState.FREE
+
+    def has_shape(self, accelerator: str, topology: str) -> bool:
+        topology = normalize_topology(topology)
+        with self._lock:
+            return any(
+                s.accelerator == accelerator and s.topology == topology
+                for s in self._slices
+            )
+
+    def get_slice(self, slice_id: str) -> Optional[Slice]:
+        with self._lock:
+            try:
+                return self._find(slice_id)
+            except KeyError:
+                return None
+
+    def list_slices(self) -> List[Slice]:
+        with self._lock:
+            return list(self._slices)
+
+    def watch(self, handler: SliceWatchHandler) -> None:
+        self._watchers.append(handler)
+
+    # -- fault injection (test-server analogue for the fabric) --
+
+    def inject_preemption(self, slice_id: str) -> Slice:
+        """The fabric takes the slice back (maintenance/defrag/preemptible
+        reclaim) — the TPU-VM event the reference maps to exit codes
+        130/137/143 (SURVEY §5 failure detection)."""
+        with self._lock:
+            s = self._find(slice_id)
+            s.state = SliceState.PREEMPTED
+        for handler in list(self._watchers):
+            handler(s, "preempted")
+        return s
+
+    def repair(self, slice_id: str) -> Slice:
+        """The fabric re-provisions a preempted slice; it returns to the
+        free pool.  A repair for a slice that is not preempted is a stale or
+        duplicate notice and is ignored — freeing a live ALLOCATED slice
+        would double-book it under a running gang."""
+        with self._lock:
+            s = self._find(slice_id)
+            if s.state != SliceState.PREEMPTED:
+                log.info("ignoring repair for %s in state %s", s.id, s.state)
+                return s
+            s.state = SliceState.FREE
+            s.holder = None
+        for handler in list(self._watchers):
+            handler(s, "repaired")
+        return s
+
+    def _find(self, slice_id: str) -> Slice:
+        for s in self._slices:
+            if s.id == slice_id:
+                return s
+        raise KeyError(f"no such slice {slice_id}")

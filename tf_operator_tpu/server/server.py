@@ -54,6 +54,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--slice-chips", type=float, default=None,
                         help="total TPU chips the gang scheduler may admit "
                              "(default unlimited)")
+    parser.add_argument("--slice-inventory", default=None,
+                        help="slice fabric inventory as "
+                             "accelerator:topology:count[,...] (e.g. "
+                             "v5litepod-32:4x8:2); enables slice-shaped "
+                             "all-or-nothing allocation")
     parser.add_argument("--monitoring-port", type=int, default=8443)
     parser.add_argument("--api-port", type=int, default=8008,
                         help="REST API port; 0 disables")
@@ -173,12 +178,37 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
         threadiness=args.threadiness,
         **({"resolver": resolver_owner.resolver} if resolver_owner else {}),
     )
+    if getattr(args, "slice_inventory", None) and not (
+        args.enable_gang_scheduling and args.gang_mechanism == "podgroup"
+    ):
+        raise SystemExit(
+            "--slice-inventory requires --enable-gang-scheduling with "
+            "--gang-mechanism podgroup (slice-shaped admission is enforced "
+            "by the gang scheduler); the inventory would otherwise be ignored"
+        )
     if args.enable_gang_scheduling and args.gang_mechanism == "podgroup":
         from ..runtime.scheduler import GangScheduler
 
+        slice_provider = None
+        if getattr(args, "slice_inventory", None):
+            from ..runtime.slices import FakeSliceProvider, parse_topology
+
+            inventory = {}
+            for entry in args.slice_inventory.split(","):
+                try:
+                    accelerator, topology, count = entry.strip().split(":")
+                    parse_topology(topology)
+                    inventory[(accelerator, topology)] = int(count)
+                except ValueError as exc:
+                    raise SystemExit(
+                        f"--slice-inventory: bad entry {entry.strip()!r} ({exc}); "
+                        "expected accelerator:topology:count, e.g. v5litepod-32:4x8:2"
+                    )
+            slice_provider = FakeSliceProvider(inventory)
         controller.gang_scheduler = GangScheduler(
             cluster, total_chips=args.slice_chips,
             scheduler_name=args.gang_scheduler_name,
+            slice_provider=slice_provider,
         )
 
     # SIGTERM/SIGINT: first one stops gracefully, second exits 1
