@@ -1,0 +1,321 @@
+// Native parameter-server shard: multi-threaded TCP tensor server with a
+// C ABI for ctypes.
+//
+// Role: the Python PS (tf_operator_tpu/train/ps.py) serializes every
+// pull/push through pickle and the GIL; under many workers the shard
+// becomes host-bound.  This server holds the shard in flat float32 buffers,
+// speaks a length-prefixed binary tensor protocol, and applies downpour-SGD
+// updates on C++ threads — Python only hosts the process.  (The reference
+// has no native code of its own — its PS data path is TF's gRPC runtime
+// inside user containers, SURVEY.md §2.9; this is the framework-owned
+// equivalent.)
+//
+// Build: g++ -O3 -shared -fPIC -o libtpujob_ps.so ps_server.cpp -lpthread
+//
+// Wire protocol (little-endian), shared with train/native_ps.py:
+//   request  frame: u8 op | u64 payload_len | payload
+//   ops: 1=PULL (no payload)
+//        2=PUSH (payload = tensor list)
+//        3=SHUTDOWN (no payload)
+//   tensor list: u32 count, then per tensor:
+//        u16 name_len | name bytes | u64 elem_count | f32 elems
+//   responses:
+//        PULL     -> u64 version | tensor list
+//        PUSH     -> u64 version (after applying)
+//        SHUTDOWN -> u64 0
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kOpPull = 1;
+constexpr uint8_t kOpPush = 2;
+constexpr uint8_t kOpShutdown = 3;
+
+bool SendAll(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool RecvAll(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void AppendU16(std::vector<char>* out, uint16_t v) {
+  out->insert(out->end(), reinterpret_cast<char*>(&v),
+              reinterpret_cast<char*>(&v) + sizeof(v));
+}
+
+void AppendU32(std::vector<char>* out, uint32_t v) {
+  out->insert(out->end(), reinterpret_cast<char*>(&v),
+              reinterpret_cast<char*>(&v) + sizeof(v));
+}
+
+void AppendU64(std::vector<char>* out, uint64_t v) {
+  out->insert(out->end(), reinterpret_cast<char*>(&v),
+              reinterpret_cast<char*>(&v) + sizeof(v));
+}
+
+class PsServer {
+ public:
+  PsServer(const std::string& host, int port, float lr)
+      : host_(host), port_(port), lr_(lr) {}
+
+  ~PsServer() { Stop(); }
+
+  int AddParam(const std::string& name, const float* data, uint64_t n) {
+    std::lock_guard<std::mutex> lock(mu_);
+    params_[name].assign(data, data + n);
+    return 0;
+  }
+
+  int GetParam(const std::string& name, float* out, uint64_t n) {
+    std::lock_guard<std::mutex> lock(mu_);
+    auto it = params_.find(name);
+    if (it == params_.end() || it->second.size() != n) return -1;
+    std::memcpy(out, it->second.data(), n * sizeof(float));
+    return 0;
+  }
+
+  uint64_t Version() {
+    std::lock_guard<std::mutex> lock(mu_);
+    return version_;
+  }
+
+  int Start() {
+    listen_fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd_ < 0) return -1;
+    int one = 1;
+    ::setsockopt(listen_fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    addr.sin_addr.s_addr =
+        host_.empty() ? INADDR_ANY : ::inet_addr(host_.c_str());
+    if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+        0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return -1;
+    }
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd_, reinterpret_cast<sockaddr*>(&addr), &len);
+    port_ = ntohs(addr.sin_port);
+    if (::listen(listen_fd_, 64) < 0) {
+      ::close(listen_fd_);
+      listen_fd_ = -1;
+      return -1;
+    }
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return 0;
+  }
+
+  int Port() const { return port_; }
+
+  void Wait() {
+    std::unique_lock<std::mutex> lock(shutdown_mu_);
+    shutdown_cv_.wait(lock, [this] { return shutdown_.load(); });
+  }
+
+  void Stop() {
+    bool expected = false;
+    if (stopping_.compare_exchange_strong(expected, true)) {
+      shutdown_.store(true);
+      shutdown_cv_.notify_all();
+      if (listen_fd_ >= 0) {
+        ::shutdown(listen_fd_, SHUT_RDWR);
+        ::close(listen_fd_);
+        listen_fd_ = -1;
+      }
+      if (accept_thread_.joinable()) accept_thread_.join();
+      // Unblock Serve threads parked in recv() on idle client connections —
+      // they only re-check shutdown_ between frames, so joining without
+      // shutting their sockets down would hang here while any client keeps
+      // its connection open.  Join outside the lock: exiting Serve threads
+      // take workers_mu_ in ForgetConn.
+      std::vector<std::thread> threads;
+      {
+        std::lock_guard<std::mutex> lock(workers_mu_);
+        for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+        threads.swap(workers_);
+      }
+      for (auto& t : threads) {
+        if (t.joinable()) t.join();
+      }
+    }
+  }
+
+ private:
+  void AcceptLoop() {
+    while (!shutdown_.load()) {
+      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      if (fd < 0) {
+        if (shutdown_.load()) return;
+        continue;
+      }
+      std::lock_guard<std::mutex> lock(workers_mu_);
+      conn_fds_.push_back(fd);
+      workers_.emplace_back([this, fd] { Serve(fd); });
+    }
+  }
+
+  void ForgetConn(int fd) {
+    std::lock_guard<std::mutex> lock(workers_mu_);
+    for (auto it = conn_fds_.begin(); it != conn_fds_.end(); ++it) {
+      if (*it == fd) {
+        conn_fds_.erase(it);
+        return;
+      }
+    }
+  }
+
+  void Serve(int fd) {
+    while (!shutdown_.load()) {
+      uint8_t op = 0;
+      uint64_t payload_len = 0;
+      if (!RecvAll(fd, &op, 1) || !RecvAll(fd, &payload_len, 8)) break;
+      std::vector<char> payload(payload_len);
+      if (payload_len > 0 && !RecvAll(fd, payload.data(), payload_len)) break;
+      if (op == kOpPull) {
+        std::vector<char> resp;
+        {
+          std::lock_guard<std::mutex> lock(mu_);
+          AppendU64(&resp, version_);
+          AppendU32(&resp, static_cast<uint32_t>(params_.size()));
+          for (const auto& kv : params_) {
+            AppendU16(&resp, static_cast<uint16_t>(kv.first.size()));
+            resp.insert(resp.end(), kv.first.begin(), kv.first.end());
+            AppendU64(&resp, kv.second.size());
+            const char* d = reinterpret_cast<const char*>(kv.second.data());
+            resp.insert(resp.end(), d, d + kv.second.size() * sizeof(float));
+          }
+        }
+        if (!SendAll(fd, resp.data(), resp.size())) break;
+      } else if (op == kOpPush) {
+        uint64_t version = ApplyPush(payload);
+        if (!SendAll(fd, &version, 8)) break;
+      } else if (op == kOpShutdown) {
+        uint64_t zero = 0;
+        SendAll(fd, &zero, 8);
+        shutdown_.store(true);
+        shutdown_cv_.notify_all();
+        break;
+      } else {
+        break;  // unknown op: drop the connection
+      }
+    }
+    ForgetConn(fd);
+    ::close(fd);
+  }
+
+  // payload: u32 count | per tensor u16 nlen | name | u64 elems | f32 data.
+  // Malformed frames are ignored past the point of damage (version still
+  // bumps for the tensors applied before it).
+  uint64_t ApplyPush(const std::vector<char>& payload) {
+    size_t off = 0;
+    auto fits = [&](size_t n) { return off + n <= payload.size(); };
+    if (!fits(4)) return Version();
+    uint32_t count;
+    std::memcpy(&count, payload.data() + off, 4);
+    off += 4;
+    std::lock_guard<std::mutex> lock(mu_);
+    for (uint32_t i = 0; i < count; ++i) {
+      if (!fits(2)) break;
+      uint16_t nlen;
+      std::memcpy(&nlen, payload.data() + off, 2);
+      off += 2;
+      if (!fits(nlen)) break;
+      std::string name(payload.data() + off, nlen);
+      off += nlen;
+      if (!fits(8)) break;
+      uint64_t elems;
+      std::memcpy(&elems, payload.data() + off, 8);
+      off += 8;
+      if (!fits(elems * sizeof(float))) break;
+      const float* grad = reinterpret_cast<const float*>(payload.data() + off);
+      off += elems * sizeof(float);
+      auto it = params_.find(name);
+      if (it == params_.end() || it->second.size() != elems) continue;
+      float* p = it->second.data();
+      const float lr = lr_;
+      for (uint64_t j = 0; j < elems; ++j) p[j] -= lr * grad[j];
+    }
+    ++version_;
+    return version_;
+  }
+
+  std::string host_;
+  int port_;
+  float lr_;
+  int listen_fd_ = -1;
+  std::thread accept_thread_;
+  std::mutex workers_mu_;
+  std::vector<std::thread> workers_;
+  std::vector<int> conn_fds_;
+  std::mutex mu_;
+  std::map<std::string, std::vector<float>> params_;
+  uint64_t version_ = 0;
+  std::atomic<bool> shutdown_{false};
+  std::atomic<bool> stopping_{false};
+  std::mutex shutdown_mu_;
+  std::condition_variable shutdown_cv_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tpujob_ps_create(const char* host, int port, float lr) {
+  return new PsServer(host ? host : "", port, lr);
+}
+
+int tpujob_ps_add_param(void* h, const char* name, const float* data,
+                        uint64_t n) {
+  return static_cast<PsServer*>(h)->AddParam(name, data, n);
+}
+
+int tpujob_ps_get_param(void* h, const char* name, float* out, uint64_t n) {
+  return static_cast<PsServer*>(h)->GetParam(name, out, n);
+}
+
+int tpujob_ps_start(void* h) { return static_cast<PsServer*>(h)->Start(); }
+
+int tpujob_ps_port(void* h) { return static_cast<PsServer*>(h)->Port(); }
+
+uint64_t tpujob_ps_version(void* h) {
+  return static_cast<PsServer*>(h)->Version();
+}
+
+void tpujob_ps_wait(void* h) { static_cast<PsServer*>(h)->Wait(); }
+
+void tpujob_ps_stop(void* h) { static_cast<PsServer*>(h)->Stop(); }
+
+void tpujob_ps_destroy(void* h) { delete static_cast<PsServer*>(h); }
+
+}  // extern "C"

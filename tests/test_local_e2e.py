@@ -271,3 +271,44 @@ def test_dist_mnist_parameter_server_job(local_stack):
         ),
         timeout=30,
     )
+
+
+@pytest.mark.slow
+def test_dist_mnist_native_transport(local_stack):
+    """Same PS job over the native C++ shard server (train/native_ps.py):
+    binary tensor protocol end-to-end across real processes."""
+    from tf_operator_tpu.train.native_ps import native_ps_available
+
+    if not native_ps_available():
+        pytest.skip("g++ toolchain unavailable")
+    cluster, controller, client, tmp = local_stack
+    worker = Container(
+        name="tensorflow", image="local",
+        command=[sys.executable, "-m", "tf_operator_tpu.workloads.dist_mnist"],
+        args=["--steps", "30", "--target-loss", "1.5", "--transport", "native"],
+    )
+    job = TPUJob(
+        metadata=ObjectMeta(name="dist-mnist-nat"),
+        spec=TPUJobSpec(replica_specs={
+            ReplicaType.PS: ReplicaSpec(
+                replicas=2,
+                template=PodTemplateSpec(containers=[
+                    Container(name="tensorflow", image="local",
+                              command=worker.command,
+                              args=["--steps", "30", "--transport", "native"])
+                ]),
+            ),
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=2,
+                template=PodTemplateSpec(containers=[worker]),
+            ),
+        }),
+    )
+    client.create(job)
+    client.wait_for_job("dist-mnist-nat", timeout=300)
+    logs = client.get_logs("dist-mnist-nat")
+    assert client.is_job_succeeded("dist-mnist-nat"), logs
+    # PS pods are reaped at terminal state; the workers witness the transport
+    worker_logs = client.get_logs("dist-mnist-nat", replica_type="worker")
+    assert any("(native transport) final loss" in t
+               for t in worker_logs.values()), worker_logs

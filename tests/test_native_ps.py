@@ -1,0 +1,110 @@
+"""Native (C++) parameter-server shard tests.
+
+Mirrors tests/test_ps.py's coverage for the native transport: pull/push
+round-trip, sharded routing, partial pushes, concurrent downpour updates,
+and cooperative shutdown (the reference's PS semantics live in TF's gRPC
+runtime; SURVEY.md §2.9).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from tf_operator_tpu.train.native_ps import (
+    NativeParameterServer,
+    NativePSClient,
+    native_ps_available,
+)
+from tf_operator_tpu.train.ps import shard_names
+
+pytestmark = pytest.mark.skipif(
+    not native_ps_available(), reason="g++ toolchain unavailable"
+)
+
+
+def make_server(params, lr=0.1):
+    return NativeParameterServer(("127.0.0.1", 0), params, lr=lr)
+
+
+def test_pull_push_roundtrip():
+    params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3), "b": np.ones(3, np.float32)}
+    server = make_server(params, lr=0.5)
+    try:
+        client = NativePSClient([f"127.0.0.1:{server.port}"])
+        pulled = client.pull()
+        assert set(pulled) == {"w", "b"}
+        np.testing.assert_allclose(pulled["w"], params["w"].ravel())
+
+        client.push({"w": np.ones(6, np.float32)})
+        np.testing.assert_allclose(
+            server.get_param("w").ravel(), params["w"].ravel() - 0.5
+        )
+        np.testing.assert_allclose(server.get_param("b"), params["b"])  # untouched
+        assert server.version == 1
+        client.close()
+    finally:
+        server.close()
+
+
+def test_sharded_routing_and_partial_push():
+    names = ["l1/w", "l1/b", "l2/w", "l2/b"]
+    full = {n: np.full(4, i, np.float32) for i, n in enumerate(names)}
+    servers = [
+        make_server({n: full[n] for n in shard_names(names, 2, i)}, lr=1.0)
+        for i in range(2)
+    ]
+    try:
+        client = NativePSClient([f"127.0.0.1:{s.port}" for s in servers])
+        pulled = client.pull()
+        assert set(pulled) == set(names)
+        # partial push routes to the owning shard only
+        client.push({"l2/w": np.ones(4, np.float32)})
+        owner = 0 if "l2/w" in shard_names(names, 2, 0) else 1
+        np.testing.assert_allclose(
+            servers[owner].get_param("l2/w"), full["l2/w"] - 1.0
+        )
+        assert servers[1 - owner].version == 0
+        with pytest.raises(KeyError):
+            client.push({"nope": np.ones(4, np.float32)})
+        client.close()
+    finally:
+        for s in servers:
+            s.close()
+
+
+def test_concurrent_downpour_updates():
+    server = make_server({"w": np.zeros(8, np.float32)}, lr=1.0)
+    try:
+        pushes_per_worker, workers = 25, 4
+
+        def worker():
+            client = NativePSClient([f"127.0.0.1:{server.port}"])
+            client.pull()
+            for _ in range(pushes_per_worker):
+                client.push({"w": np.ones(8, np.float32)})
+            client.close()
+
+        threads = [threading.Thread(target=worker) for _ in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert server.version == pushes_per_worker * workers
+        np.testing.assert_allclose(
+            server.get_param("w"),
+            np.full(8, -float(pushes_per_worker * workers), np.float32),
+        )
+    finally:
+        server.close()
+
+
+def test_shutdown_unblocks_server():
+    server = make_server({"w": np.zeros(2, np.float32)})
+    waiter = threading.Thread(target=server.serve_until_shutdown)
+    waiter.start()
+    client = NativePSClient([f"127.0.0.1:{server.port}"])
+    client.shutdown_servers()
+    waiter.join(timeout=10)
+    assert not waiter.is_alive()
+    client.close()
+    server.close()

@@ -334,6 +334,16 @@ def test_multislice_multi_type_rejected():
         job, ReplicaType.WORKER, 9
     )
 
+    # dynamic workers must fit one slice (scale-up past the boundary would
+    # hand new pods a MEGASCALE doc the running members lack)
+    job3 = sliced_job("mt-c", workers=16)
+    job3.spec.enable_dynamic_worker = True
+    with pytest.raises(ValidationError, match="enableDynamicWorker"):
+        validate(job3)
+    job4 = sliced_job("mt-d", workers=8)  # fits one slice: fine
+    job4.spec.enable_dynamic_worker = True
+    validate(job4)
+
     # single-slice jobs may spread topologies over types (no DCN document)
     job2 = new_tpujob(worker=4, chief=1, name="mt-b")
     job2.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(

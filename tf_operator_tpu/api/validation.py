@@ -57,7 +57,13 @@ def _validate_multislice(spec: TPUJobSpec) -> None:
     JAX-process replica type carrying a slice topology: all accelerator
     processes share one jax.distributed group, and a MEGASCALE document that
     differs across the group (or is absent for some members) hangs libtpu
-    multislice init (controller/topology.py:_add_multislice_env)."""
+    multislice init (controller/topology.py:_add_multislice_env).
+
+    For the same reason a dynamic-worker group must fit a single slice:
+    scaling across the slice boundary would create pods whose MEGASCALE env
+    disagrees with the running members' (created when the group was
+    single-slice).  This also rejects the scale-up update itself — the
+    controller re-validates on every event."""
     from .types import topology_hosts
 
     sliced_jax_types = []
@@ -80,6 +86,12 @@ def _validate_multislice(spec: TPUJobSpec) -> None:
         raise ValidationError(
             "TPUJobSpec is not valid: a multislice job must keep all its "
             f"accelerator processes in one replica type, found topologies on {names}"
+        )
+    if multislice and spec.enable_dynamic_worker:
+        raise ValidationError(
+            "TPUJobSpec is not valid: enableDynamicWorker requires the worker "
+            "group to fit one slice (scaling across the slice boundary would "
+            "give new pods a MEGASCALE document the running members lack)"
         )
 
 

@@ -302,6 +302,12 @@ class GangScheduler:
         assignment: List[tuple] = []
         bind_plain: List[Pod] = []
         with self._lock:
+            if key not in self._admitted:
+                # The gang departed between the caller's admitted-snapshot
+                # and here (its reservation is gone): allocating now would
+                # park slices under a dead key forever.  The pods that
+                # prompted this call re-enter through fresh admission.
+                return
             slots = self._slots.setdefault(key, {})
             fresh: Dict[tuple, List[Pod]] = {}
             for pod in unbound:

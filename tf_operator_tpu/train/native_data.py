@@ -10,11 +10,12 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import Dict, Iterator, Optional
 
 import numpy as np
+
+from ..utils.native_build import load_native_lib
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "dataloader.cpp"))
@@ -36,21 +37,8 @@ def _load() -> Optional[ctypes.CDLL]:
             return _lib
         if _build_failed:
             return None
-        if not os.path.exists(_LIB) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
-        ):
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB, _SRC, "-lpthread"],
-                    check=True, capture_output=True, timeout=120,
-                )
-            except (OSError, subprocess.SubprocessError):
-                _build_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_LIB)
-        except OSError:
+        lib = load_native_lib(_SRC, _LIB)
+        if lib is None:
             _build_failed = True
             return None
         lib.dl_create.restype = ctypes.c_void_p

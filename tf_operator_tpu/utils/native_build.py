@@ -1,0 +1,42 @@
+"""Shared build-on-first-use loader for the native (C++) runtime libraries.
+
+Compiles a single-file C++ source into a shared library with g++ and dlopens
+it.  The build is process-safe: g++ writes to a per-process temp path which
+is then os.replace()'d over the target — concurrent cold-start processes
+(e.g. 2 PS + 2 workers of a local job all importing the binding at once)
+each produce a complete .so and the rename is atomic, so no process ever
+dlopens a half-written file.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+
+def load_native_lib(src: str, lib: str, timeout: float = 120.0) -> Optional[ctypes.CDLL]:
+    """Build `src` -> `lib` if missing/stale, then dlopen.  Returns None if
+    the toolchain is unavailable or the build fails (callers fall back to
+    their Python reference implementation)."""
+    stale = not os.path.exists(lib) or (
+        os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(lib)
+    )
+    if stale:
+        tmp = f"{lib}.tmp.{os.getpid()}"
+        try:
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src, "-lpthread"],
+                check=True, capture_output=True, timeout=timeout,
+            )
+            os.replace(tmp, lib)
+        except (OSError, subprocess.SubprocessError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
+    try:
+        return ctypes.CDLL(lib)
+    except OSError:
+        return None

@@ -7,11 +7,16 @@ params, compute local grads with JAX, and push asynchronously.  Worker 0's
 clean exit marks the job Succeeded (the worker-0 rule); PS replicas park
 until CleanPodPolicy reaps them.
 
+Two transports: the Python socket PS (train/ps.py, the reference
+implementation) and the native C++ shard server (train/native_ps.py) —
+pick with --transport or env TPUJOB_PS_TRANSPORT.
+
 Usage: python -m tf_operator_tpu.workloads.dist_mnist --steps 100
 """
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -22,6 +27,13 @@ def main(argv=None) -> int:
     parser.add_argument("--batch", type=int, default=64)
     parser.add_argument("--lr", type=float, default=0.1)
     parser.add_argument("--target-loss", type=float, default=None)
+    parser.add_argument(
+        "--transport",
+        choices=("python", "native"),
+        default=os.environ.get("TPUJOB_PS_TRANSPORT", "python"),
+        help="PS wire transport: python (pickle sockets) or native (C++ "
+             "shard server, binary protocol)",
+    )
     args = parser.parse_args(argv)
 
     from .runner import WorkloadContext, apply_forced_platform
@@ -53,6 +65,15 @@ def main(argv=None) -> int:
     init_params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 784)))["params"]
     flat_init = ps_lib.flatten_params(init_params)
 
+    native = args.transport == "native"
+    if native:
+        from ..train import native_ps
+
+        if not native_ps.native_ps_available():
+            print("native PS transport unavailable; falling back to python",
+                  flush=True)
+            native = False
+
     if ctx.replica_type == "ps":
         # Serve this shard until a worker sends shutdown (or we are reaped).
         my_names = ps_lib.shard_names(
@@ -60,15 +81,23 @@ def main(argv=None) -> int:
         )
         shard = {n: flat_init[n] for n in my_names}
         _, _, port = ps_addresses[ctx.replica_index].rpartition(":")
-        server = ps_lib.ParameterServer(("0.0.0.0", int(port)), shard, lr=args.lr)
-        print(f"ps {ctx.replica_index} serving {len(shard)} leaves on :{port}",
-              flush=True)
+        if native:
+            server = native_ps.NativeParameterServer(
+                ("0.0.0.0", int(port)), shard, lr=args.lr
+            )
+        else:
+            server = ps_lib.ParameterServer(("0.0.0.0", int(port)), shard, lr=args.lr)
+        print(f"ps {ctx.replica_index} ({'native' if native else 'python'}) "
+              f"serving {len(shard)} leaves on :{port}", flush=True)
         server.serve_until_shutdown()
         print("ps shutdown", flush=True)
         return 0
 
     # --- worker ---
-    client = ps_lib.PSClient(ps_addresses)
+    if native:
+        client = native_ps.NativePSClient(ps_addresses)
+    else:
+        client = ps_lib.PSClient(ps_addresses)
     # PS processes may come up after us; retry the first pull.
     for attempt in range(60):
         try:
@@ -90,11 +119,19 @@ def main(argv=None) -> int:
 
         return jax.value_and_grad(loss_fn)(params)
 
+    def to_tree(flat):
+        # The native wire carries shapeless float32 buffers: reshape against
+        # the deterministic init tree (same seed on every process).
+        if native:
+            flat = {n: np.asarray(a).reshape(flat_init[n].shape)
+                    for n, a in flat.items()}
+        return ps_lib.unflatten_params(flat)
+
     data = synthetic_mnist(args.batch, seed=100 + ctx.replica_index)
     loss = float("inf")
     for step_idx in range(args.steps):
         batch = next(data)
-        params = ps_lib.unflatten_params(client.pull())
+        params = to_tree(client.pull())
         loss_val, grads = grad_fn(
             params, jnp.asarray(batch["x"]), jnp.asarray(batch["label"])
         )
@@ -103,7 +140,8 @@ def main(argv=None) -> int:
         if step_idx % 10 == 0:
             print(f"worker {ctx.replica_index} step {step_idx} loss {loss:.4f}",
                   flush=True)
-    print(f"worker {ctx.replica_index} final loss {loss:.4f}", flush=True)
+    print(f"worker {ctx.replica_index} ({'native' if native else 'python'} "
+          f"transport) final loss {loss:.4f}", flush=True)
     client.close()
     if args.target_loss is not None and loss > args.target_loss:
         return 1
