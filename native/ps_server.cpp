@@ -181,7 +181,16 @@ class PsServer {
       }
       std::lock_guard<std::mutex> lock(workers_mu_);
       conn_fds_.push_back(fd);
-      workers_.emplace_back([this, fd] { Serve(fd); });
+      workers_.emplace_back([this, fd] {
+        // A throwing handler (bad_alloc on a corrupt frame, ...) must drop
+        // one connection, not std::terminate the whole shard process.
+        try {
+          Serve(fd);
+        } catch (...) {
+          ForgetConn(fd);
+          ::close(fd);
+        }
+      });
     }
   }
 
@@ -195,11 +204,17 @@ class PsServer {
     }
   }
 
+  // Largest frame a well-formed client can need (shard sizes are model
+  // parameters, far below this); anything bigger is a corrupt or hostile
+  // frame and drops the connection instead of attempting the allocation.
+  static constexpr uint64_t kMaxPayload = 1ull << 31;  // 2 GiB
+
   void Serve(int fd) {
     while (!shutdown_.load()) {
       uint8_t op = 0;
       uint64_t payload_len = 0;
       if (!RecvAll(fd, &op, 1) || !RecvAll(fd, &payload_len, 8)) break;
+      if (payload_len > kMaxPayload) break;
       std::vector<char> payload(payload_len);
       if (payload_len > 0 && !RecvAll(fd, payload.data(), payload_len)) break;
       if (op == kOpPull) {

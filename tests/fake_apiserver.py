@@ -1,0 +1,265 @@
+"""Minimal in-memory Kubernetes apiserver for backend tests.
+
+The reference tests its client layer against client-go fakes; the analogous
+seam here is HTTP — this server speaks the small apiserver subset
+runtime/k8s.py uses: namespaced CRUD with labelSelector/fieldSelector
+filtering, the TPUJob status subresource (merge-patch), pod eviction with a
+toggleable 429, Lease CRUD, and chunked watch streams with initial-list
+resourceVersion semantics.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+# collection key: (api_root, namespace, kind_plural)
+_COLLECTION_RE = re.compile(
+    r"^/(?:api/v1|apis/(?P<group>[^/]+/[^/]+))"
+    r"(?:/namespaces/(?P<ns>[^/]+))?/(?P<kind>[a-z]+)"
+    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status|eviction))?$"
+)
+
+
+class FakeApiServer:
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        # (kind, namespace) -> name -> object dict
+        self._store: Dict[Tuple[str, str], Dict[str, dict]] = {}
+        self._rv = 0
+        self._watchers: List[Tuple[str, "queue.Queue"]] = []
+        self.block_evictions = False
+        self.requests: List[Tuple[str, str]] = []  # (method, path) log
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"  # stream-until-close for watches
+
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _read_body(self) -> dict:
+                length = int(self.headers.get("Content-Length") or 0)
+                if not length:
+                    return {}
+                return json.loads(self.rfile.read(length))
+
+            def _reply(self, code: int, payload: dict) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _error(self, code: int, message: str) -> None:
+                self._reply(code, {"kind": "Status", "code": code,
+                                   "message": message})
+
+            def do_GET(self):
+                server.requests.append(("GET", self.path))
+                parts = urlsplit(self.path)
+                params = {k: v[0] for k, v in parse_qs(parts.query).items()}
+                m = _COLLECTION_RE.match(parts.path)
+                if not m:
+                    return self._error(404, f"no route {parts.path}")
+                kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
+                if params.get("watch") == "true":
+                    return self._serve_watch(kind, ns, params)
+                with server._lock:
+                    if name:
+                        obj = server._get(kind, ns, name)
+                        if obj is None:
+                            return self._error(404, f"{kind} {ns}/{name} not found")
+                        return self._reply(200, obj)
+                    items = server._list(kind, ns, params)
+                    return self._reply(200, {
+                        "kind": "List", "items": items,
+                        "metadata": {"resourceVersion": str(server._rv)},
+                    })
+
+            def _serve_watch(self, kind, ns, params):
+                q: "queue.Queue" = queue.Queue()
+                with server._lock:
+                    server._watchers.append((kind, q))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                try:
+                    while True:
+                        evt = q.get(timeout=30)
+                        if ns and (evt["object"].get("metadata") or {}).get(
+                            "namespace"
+                        ) != ns:
+                            continue
+                        self.wfile.write(json.dumps(evt).encode() + b"\n")
+                        self.wfile.flush()
+                except (queue.Empty, BrokenPipeError, ConnectionError, OSError):
+                    pass
+                finally:
+                    with server._lock:
+                        try:
+                            server._watchers.remove((kind, q))
+                        except ValueError:
+                            pass
+
+            def do_POST(self):
+                server.requests.append(("POST", self.path))
+                m = _COLLECTION_RE.match(urlsplit(self.path).path)
+                if not m:
+                    return self._error(404, f"no route {self.path}")
+                kind, ns, name, sub = (
+                    m.group("kind"), m.group("ns"), m.group("name"), m.group("sub"),
+                )
+                body = self._read_body()
+                if sub == "eviction":
+                    if server.block_evictions:
+                        return self._error(429, "disruption budget blocks eviction")
+                    with server._lock:
+                        server._delete(kind, ns, name)
+                    return self._reply(200, {"kind": "Status", "code": 200})
+                with server._lock:
+                    obj_name = (body.get("metadata") or {}).get("name", "")
+                    if server._get(kind, ns, obj_name) is not None:
+                        return self._error(409, f"{kind} {obj_name} exists")
+                    created = server._put(kind, ns, obj_name, body, new=True)
+                return self._reply(201, created)
+
+            def do_PUT(self):
+                server.requests.append(("PUT", self.path))
+                m = _COLLECTION_RE.match(urlsplit(self.path).path)
+                if not m or not m.group("name"):
+                    return self._error(404, f"no route {self.path}")
+                kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
+                with server._lock:
+                    if server._get(kind, ns, name) is None:
+                        return self._error(404, f"{kind} {ns}/{name} not found")
+                    updated = server._put(kind, ns, name, self._read_body())
+                return self._reply(200, updated)
+
+            def do_PATCH(self):
+                server.requests.append(("PATCH", self.path))
+                m = _COLLECTION_RE.match(urlsplit(self.path).path)
+                if not m or not m.group("name"):
+                    return self._error(404, f"no route {self.path}")
+                kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
+                patch = self._read_body()
+                with server._lock:
+                    obj = server._get(kind, ns, name)
+                    if obj is None:
+                        return self._error(404, f"{kind} {ns}/{name} not found")
+                    merged = _merge_patch(obj, patch)
+                    updated = server._put(kind, ns, name, merged)
+                return self._reply(200, updated)
+
+            def do_DELETE(self):
+                server.requests.append(("DELETE", self.path))
+                m = _COLLECTION_RE.match(urlsplit(self.path).path)
+                if not m or not m.group("name"):
+                    return self._error(404, f"no route {self.path}")
+                kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
+                with server._lock:
+                    if server._get(kind, ns, name) is None:
+                        return self._error(404, f"{kind} {ns}/{name} not found")
+                    server._delete(kind, ns, name)
+                return self._reply(200, {"kind": "Status", "code": 200})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    # -- store helpers (caller holds _lock) --
+
+    def _get(self, kind: str, ns: Optional[str], name: str) -> Optional[dict]:
+        return self._store.get((kind, ns or "default"), {}).get(name)
+
+    def _list(self, kind: str, ns: Optional[str], params: Dict[str, str]) -> List[dict]:
+        buckets = (
+            [self._store.get((kind, ns), {})]
+            if ns
+            else [v for (k, _), v in self._store.items() if k == kind]
+        )
+        items = [obj for bucket in buckets for obj in bucket.values()]
+        selector = params.get("labelSelector")
+        if selector:
+            want = dict(kv.split("=", 1) for kv in selector.split(","))
+            items = [
+                o for o in items
+                if all(((o.get("metadata") or {}).get("labels") or {}).get(k) == v
+                       for k, v in want.items())
+            ]
+        field = params.get("fieldSelector")
+        if field and field.startswith("involvedObject.name="):
+            target = field.split("=", 1)[1]
+            items = [o for o in items
+                     if (o.get("involvedObject") or {}).get("name") == target]
+        return items
+
+    def _put(self, kind: str, ns: Optional[str], name: str, obj: dict,
+             new: bool = False) -> dict:
+        ns = ns or (obj.get("metadata") or {}).get("namespace", "default")
+        self._rv += 1
+        meta = obj.setdefault("metadata", {})
+        meta.setdefault("namespace", ns)
+        meta["resourceVersion"] = str(self._rv)
+        if new:
+            meta.setdefault("uid", f"uid-{kind}-{name}-{self._rv}")
+            meta.setdefault("creationTimestamp", "2026-01-01T00:00:00Z")
+        existed = name in self._store.setdefault((kind, ns), {})
+        self._store[(kind, ns)][name] = obj
+        self._notify(kind, "MODIFIED" if existed and not new else "ADDED", obj)
+        return obj
+
+    def _delete(self, kind: str, ns: Optional[str], name: str) -> None:
+        ns = ns or "default"
+        obj = self._store.get((kind, ns), {}).pop(name, None)
+        if obj is not None:
+            self._rv += 1
+            self._notify(kind, "DELETED", obj)
+
+    def _notify(self, kind: str, etype: str, obj: dict) -> None:
+        for wkind, q in list(self._watchers):
+            if wkind == kind:
+                q.put({"type": etype, "object": obj})
+
+    # -- lifecycle / test hooks --
+
+    def start(self) -> str:
+        self._thread.start()
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def set_pod_status(self, namespace: str, name: str, status: dict) -> None:
+        """Kubelet stand-in: write a pod's status and fire the watch."""
+        with self._lock:
+            pod = self._get("pods", namespace, name)
+            if pod is None:
+                raise KeyError(name)
+            pod["status"] = status
+            self._put("pods", namespace, name, pod)
+
+    def objects(self, kind: str, namespace: str = "default") -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._store.get((kind, namespace), {}))
+
+
+def _merge_patch(base: dict, patch: dict) -> dict:
+    out = dict(base)
+    for key, value in patch.items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _merge_patch(out[key], value)
+        elif value is None:
+            out.pop(key, None)
+        else:
+            out[key] = value
+    return out

@@ -77,6 +77,10 @@ class Container:
     env: List[EnvVar] = field(default_factory=list)
     ports: List[ContainerPort] = field(default_factory=list)
     resources: Dict[str, float] = field(default_factory=dict)
+    # volumeMounts, probes, securityContext, ... passthrough (same philosophy
+    # as PodTemplateSpec.extra) — the k8s backend must not strip fields the
+    # reconcile engine doesn't read.
+    extra: Dict[str, Any] = field(default_factory=dict)
 
     def set_env(self, name: str, value: str) -> None:
         for e in self.env:

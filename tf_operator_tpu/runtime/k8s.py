@@ -1,0 +1,1010 @@
+"""Kubernetes backend for ClusterInterface — stdlib-only client-go analogue.
+
+The reference drives a real apiserver through client-go clientsets and
+shared informers (SURVEY.md §1 L0/L1).  This backend gives the same
+controller that capability with no external dependencies: an HTTP(S) client
+built on http.client + ssl, kubeconfig/in-cluster auth, typed converters
+between the framework's object model (api/core.py) and Kubernetes JSON, and
+watch threads translating the apiserver's chunked watch stream into the
+ClusterInterface callback contract (the informer analogue,
+ref: pkg/common/util/v1/unstructured/informer.go:25-63).
+
+Resource mapping:
+  TPUJob      -> apis/tpu-operator.dev/v1 tpujobs (manifests/crd.yaml)
+  Pod/Service/Event -> core v1
+  PodGroup    -> apis/scheduling.volcano.sh/v1beta1 podgroups (the gang unit
+                 the reference stamps, vendor/.../common/pod.go:42-53)
+  PodDisruptionBudget -> apis/policy/v1
+  Lease       -> apis/coordination.k8s.io/v1 (leader election; the reference
+                 uses an EndpointsLock, server.go:159-184 — Leases are the
+                 modern equivalent)
+"""
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+import ssl
+import threading
+import time
+from http.client import HTTPConnection, HTTPSConnection
+from typing import Any, Callable, Dict, List, Optional
+from urllib.parse import urlencode, urlsplit
+
+from ..api import constants, serialization
+from ..api.core import (
+    Container,
+    ContainerPort,
+    ContainerStatus,
+    EnvVar,
+    Event,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+    PodGroup,
+    PodPhase,
+    PodStatus,
+    PodTemplateSpec,
+    Service,
+    ServicePort,
+)
+from ..api.types import JobStatus, TPUJob
+from ..utils import logging as tpulog
+from .cluster import (
+    AlreadyExists,
+    ClusterInterface,
+    EventType,
+    EvictionBlocked,
+    NotFound,
+    WatchHandler,
+)
+
+log = tpulog.logger_for_key("k8s")
+
+PODGROUP_API = "scheduling.volcano.sh/v1beta1"
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+# ---------------------------------------------------------------------------
+# time / quantity helpers
+
+
+def to_rfc3339(ts: Optional[float]) -> Optional[str]:
+    if ts is None:
+        return None
+    return _dt.datetime.fromtimestamp(ts, _dt.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
+def from_rfc3339(text: Optional[str]) -> Optional[float]:
+    if not text:
+        return None
+    try:
+        dt = _dt.datetime.strptime(text, "%Y-%m-%dT%H:%M:%SZ")
+    except ValueError:
+        try:
+            dt = _dt.datetime.fromisoformat(text.replace("Z", "+00:00"))
+        except ValueError:
+            return None
+    return dt.replace(tzinfo=_dt.timezone.utc).timestamp()
+
+
+def quantity_to_str(value: float) -> str:
+    return str(int(value)) if float(value).is_integer() else str(value)
+
+
+def quantity_to_float(text: Any) -> float:
+    """Parse the k8s quantity subset relevant to device counts ("4", "2k")."""
+    s = str(text)
+    suffixes = {"k": 1e3, "M": 1e6, "G": 1e9, "m": 1e-3}
+    if s and s[-1] in suffixes:
+        return float(s[:-1]) * suffixes[s[-1]]
+    try:
+        return float(s)
+    except ValueError:
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# object converters (core model <-> Kubernetes JSON)
+
+
+def meta_to_k8s(meta: ObjectMeta) -> Dict[str, Any]:
+    out: Dict[str, Any] = {
+        "name": meta.name,
+        "namespace": meta.namespace,
+        "labels": dict(meta.labels),
+        "annotations": dict(meta.annotations),
+    }
+    if meta.uid:
+        out["uid"] = meta.uid
+    if meta.owner_kind:
+        out["ownerReferences"] = [{
+            "apiVersion": f"{constants.API_GROUP}/{constants.API_VERSION}",
+            "kind": meta.owner_kind,
+            "name": meta.owner_name,
+            "uid": meta.owner_uid,
+            "controller": True,
+            "blockOwnerDeletion": True,
+        }]
+    return out
+
+
+def meta_from_k8s(raw: Dict[str, Any]) -> ObjectMeta:
+    meta = ObjectMeta(
+        name=raw.get("name", ""),
+        namespace=raw.get("namespace", "default"),
+        uid=raw.get("uid", ""),
+        labels=dict(raw.get("labels") or {}),
+        annotations=dict(raw.get("annotations") or {}),
+    )
+    created = from_rfc3339(raw.get("creationTimestamp"))
+    if created is not None:
+        meta.creation_timestamp = created
+    meta.deletion_timestamp = from_rfc3339(raw.get("deletionTimestamp"))
+    for ref in raw.get("ownerReferences") or []:
+        if ref.get("controller"):
+            meta.owner_kind = ref.get("kind", "")
+            meta.owner_name = ref.get("name", "")
+            meta.owner_uid = ref.get("uid", "")
+            break
+    return meta
+
+
+_CONTAINER_KNOWN = {"name", "image", "command", "args", "env", "ports", "resources"}
+
+
+def container_to_k8s(c: Container) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"name": c.name, "image": c.image}
+    if c.command:
+        out["command"] = list(c.command)
+    if c.args:
+        out["args"] = list(c.args)
+    if c.env:
+        out["env"] = [{"name": e.name, "value": e.value} for e in c.env]
+    if c.ports:
+        out["ports"] = [
+            {"name": p.name, "containerPort": p.container_port} for p in c.ports
+        ]
+    if c.resources:
+        limits = {k: quantity_to_str(v) for k, v in c.resources.items()}
+        out["resources"] = {"limits": limits, "requests": dict(limits)}
+    out.update(c.extra)  # volumeMounts, probes, ... passthrough
+    return out
+
+
+def container_from_k8s(raw: Dict[str, Any]) -> Container:
+    resources: Dict[str, float] = {}
+    for k, v in (raw.get("resources", {}).get("limits") or {}).items():
+        resources[k] = quantity_to_float(v)
+    return Container(
+        name=raw.get("name", ""),
+        image=raw.get("image", ""),
+        command=list(raw.get("command") or []),
+        args=list(raw.get("args") or []),
+        env=[EnvVar(e.get("name", ""), e.get("value", ""))
+             for e in raw.get("env") or [] if "valueFrom" not in e],
+        ports=[ContainerPort(p.get("name", ""), int(p.get("containerPort", 0)))
+               for p in raw.get("ports") or []],
+        resources=resources,
+        extra={k: v for k, v in raw.items() if k not in _CONTAINER_KNOWN},
+    )
+
+
+def pod_to_k8s(pod: Pod) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "containers": [container_to_k8s(c) for c in pod.spec.containers],
+        "restartPolicy": pod.spec.restart_policy or "Never",
+    }
+    if pod.spec.scheduler_name:
+        spec["schedulerName"] = pod.spec.scheduler_name
+    if pod.spec.node_selector:
+        spec["nodeSelector"] = dict(pod.spec.node_selector)
+    spec.update(pod.spec.extra)  # volumes, affinity, ... passthrough
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": meta_to_k8s(pod.metadata),
+        "spec": spec,
+    }
+
+
+def pod_from_k8s(raw: Dict[str, Any]) -> Pod:
+    spec_raw = raw.get("spec") or {}
+    known = {"containers", "restartPolicy", "schedulerName", "nodeSelector"}
+    template = PodTemplateSpec(
+        containers=[container_from_k8s(c) for c in spec_raw.get("containers") or []],
+        restart_policy=spec_raw.get("restartPolicy", ""),
+        scheduler_name=spec_raw.get("schedulerName", ""),
+        node_selector=dict(spec_raw.get("nodeSelector") or {}),
+        extra={k: v for k, v in spec_raw.items() if k not in known},
+    )
+    status_raw = raw.get("status") or {}
+    statuses: List[ContainerStatus] = []
+    for cs in status_raw.get("containerStatuses") or []:
+        state = cs.get("state") or {}
+        terminated = state.get("terminated")
+        statuses.append(ContainerStatus(
+            name=cs.get("name", ""),
+            restart_count=int(cs.get("restartCount", 0)),
+            running="running" in state,
+            terminated=terminated is not None,
+            exit_code=(int(terminated["exitCode"])
+                       if terminated and "exitCode" in terminated else None),
+        ))
+    try:
+        phase = PodPhase(status_raw.get("phase", "Pending"))
+    except ValueError:
+        phase = PodPhase.UNKNOWN
+    return Pod(
+        metadata=meta_from_k8s(raw.get("metadata") or {}),
+        spec=template,
+        status=PodStatus(
+            phase=phase,
+            container_statuses=statuses,
+            start_time=from_rfc3339(status_raw.get("startTime")),
+            reason=status_raw.get("reason", ""),
+            message=status_raw.get("message", ""),
+        ),
+    )
+
+
+def service_to_k8s(svc: Service) -> Dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": meta_to_k8s(svc.metadata),
+        "spec": {
+            "clusterIP": svc.cluster_ip,  # "None" = headless (service.go:303-309)
+            "selector": dict(svc.selector),
+            "ports": [{"name": p.name or None, "port": p.port} for p in svc.ports],
+        },
+    }
+
+
+def service_from_k8s(raw: Dict[str, Any]) -> Service:
+    spec_raw = raw.get("spec") or {}
+    return Service(
+        metadata=meta_from_k8s(raw.get("metadata") or {}),
+        selector=dict(spec_raw.get("selector") or {}),
+        ports=[ServicePort(p.get("name") or "", int(p.get("port", 0)))
+               for p in spec_raw.get("ports") or []],
+        cluster_ip=spec_raw.get("clusterIP", "None"),
+    )
+
+
+def job_to_k8s(job: TPUJob) -> Dict[str, Any]:
+    data = serialization.job_to_dict(job)
+    data["metadata"] = meta_to_k8s(job.metadata)
+    return data
+
+
+def podgroup_to_k8s(pg: PodGroup) -> Dict[str, Any]:
+    return {
+        "apiVersion": PODGROUP_API,
+        "kind": "PodGroup",
+        "metadata": meta_to_k8s(pg.metadata),
+        "spec": {"minMember": pg.min_member, "queue": pg.queue or "default"},
+        "status": {"phase": pg.phase},
+    }
+
+
+def podgroup_from_k8s(raw: Dict[str, Any]) -> PodGroup:
+    spec_raw = raw.get("spec") or {}
+    return PodGroup(
+        metadata=meta_from_k8s(raw.get("metadata") or {}),
+        min_member=int(spec_raw.get("minMember", 0)),
+        queue=spec_raw.get("queue", ""),
+        phase=(raw.get("status") or {}).get("phase", "Pending"),
+    )
+
+
+def pdb_to_k8s(pdb: PodDisruptionBudget) -> Dict[str, Any]:
+    return {
+        "apiVersion": "policy/v1",
+        "kind": "PodDisruptionBudget",
+        "metadata": meta_to_k8s(pdb.metadata),
+        "spec": {
+            "minAvailable": pdb.min_available,
+            "selector": {"matchLabels": dict(pdb.selector)},
+        },
+    }
+
+
+def pdb_from_k8s(raw: Dict[str, Any]) -> PodDisruptionBudget:
+    spec_raw = raw.get("spec") or {}
+    return PodDisruptionBudget(
+        metadata=meta_from_k8s(raw.get("metadata") or {}),
+        min_available=int(spec_raw.get("minAvailable", 0)),
+        selector=dict((spec_raw.get("selector") or {}).get("matchLabels") or {}),
+    )
+
+
+def event_to_k8s(event: Event, suffix: str) -> Dict[str, Any]:
+    ts = to_rfc3339(event.timestamp)
+    return {
+        "apiVersion": "v1",
+        "kind": "Event",
+        "metadata": {
+            "name": f"{event.object_name}.{suffix}",
+            "namespace": event.namespace,
+        },
+        "involvedObject": {
+            "kind": event.object_kind,
+            "name": event.object_name,
+            "namespace": event.namespace,
+        },
+        "type": event.event_type,
+        "reason": event.reason,
+        "message": event.message,
+        "firstTimestamp": ts,
+        "lastTimestamp": ts,
+        "count": 1,
+        "source": {"component": "tpu-operator"},
+    }
+
+
+def event_from_k8s(raw: Dict[str, Any]) -> Event:
+    involved = raw.get("involvedObject") or {}
+    return Event(
+        object_kind=involved.get("kind", ""),
+        object_name=involved.get("name", ""),
+        namespace=involved.get("namespace")
+        or (raw.get("metadata") or {}).get("namespace", "default"),
+        event_type=raw.get("type", "Normal"),
+        reason=raw.get("reason", ""),
+        message=raw.get("message", ""),
+        timestamp=from_rfc3339(raw.get("lastTimestamp")) or time.time(),
+    )
+
+
+# ---------------------------------------------------------------------------
+# transport
+
+
+class ApiError(RuntimeError):
+    def __init__(self, code: int, message: str) -> None:
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+class KubeConfig:
+    """Connection parameters for one apiserver."""
+
+    def __init__(self, host: str, token: Optional[str] = None,
+                 ca_file: Optional[str] = None,
+                 cert_file: Optional[str] = None,
+                 key_file: Optional[str] = None,
+                 verify: bool = True,
+                 namespace: str = "default") -> None:
+        self.host = host.rstrip("/")
+        self.token = token
+        self.ca_file = ca_file
+        self.cert_file = cert_file
+        self.key_file = key_file
+        self.verify = verify
+        self.namespace = namespace
+
+    @classmethod
+    def in_cluster(cls) -> "KubeConfig":
+        """Pod-mounted service account (the deployment path,
+        manifests/deployment.yaml)."""
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        with open(os.path.join(SERVICE_ACCOUNT_DIR, "token")) as f:
+            token = f.read().strip()
+        ns_path = os.path.join(SERVICE_ACCOUNT_DIR, "namespace")
+        namespace = "default"
+        if os.path.exists(ns_path):
+            with open(ns_path) as f:
+                namespace = f.read().strip() or "default"
+        return cls(
+            host=f"https://{host}:{port}",
+            token=token,
+            ca_file=os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt"),
+            namespace=namespace,
+        )
+
+    @classmethod
+    def from_kubeconfig(cls, path: Optional[str] = None,
+                        context: Optional[str] = None) -> "KubeConfig":
+        """Parse the kubeconfig subset the reference relies on
+        (clientcmd.BuildConfigFromFlags, server.go:94-109): cluster server +
+        CA, user token or client cert/key.  Inline (base64) credentials are
+        materialized to temp files."""
+        import base64
+        import tempfile
+
+        import yaml
+
+        path = path or os.environ.get(
+            "KUBECONFIG", os.path.expanduser("~/.kube/config")
+        )
+        with open(path) as f:
+            cfg = yaml.safe_load(f)
+
+        ctx_name = context or cfg.get("current-context")
+        ctx = next(
+            c["context"] for c in cfg.get("contexts", [])
+            if c.get("name") == ctx_name
+        )
+        cluster = next(
+            c["cluster"] for c in cfg.get("clusters", [])
+            if c.get("name") == ctx["cluster"]
+        )
+        user = next(
+            (u["user"] for u in cfg.get("users", [])
+             if u.get("name") == ctx.get("user")),
+            {},
+        )
+
+        def materialize(data_key: str, file_key: str, blob: dict) -> Optional[str]:
+            if blob.get(file_key):
+                return blob[file_key]
+            if blob.get(data_key):
+                tmp = tempfile.NamedTemporaryFile(delete=False, suffix=".pem")
+                tmp.write(base64.b64decode(blob[data_key]))
+                tmp.close()
+                return tmp.name
+            return None
+
+        return cls(
+            host=cluster["server"],
+            token=user.get("token"),
+            ca_file=materialize(
+                "certificate-authority-data", "certificate-authority", cluster
+            ),
+            cert_file=materialize(
+                "client-certificate-data", "client-certificate", user
+            ),
+            key_file=materialize("client-key-data", "client-key", user),
+            verify=not cluster.get("insecure-skip-tls-verify", False),
+            namespace=ctx.get("namespace", "default"),
+        )
+
+
+class KubeClient:
+    """Minimal REST client: one connection per request (watches hold theirs
+    open), JSON in/out, standard k8s error mapping."""
+
+    def __init__(self, config: KubeConfig, timeout: float = 30.0) -> None:
+        self.config = config
+        self.timeout = timeout
+        parts = urlsplit(config.host)
+        self._scheme = parts.scheme or "https"
+        self._netloc = parts.netloc or parts.path
+        self._ssl: Optional[ssl.SSLContext] = None
+        if self._scheme == "https":
+            ctx = ssl.create_default_context(cafile=config.ca_file)
+            if not config.verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            if config.cert_file:
+                ctx.load_cert_chain(config.cert_file, config.key_file)
+            self._ssl = ctx
+
+    def _connect(self, timeout: Optional[float]):
+        if self._scheme == "https":
+            return HTTPSConnection(self._netloc, timeout=timeout, context=self._ssl)
+        return HTTPConnection(self._netloc, timeout=timeout)
+
+    def _headers(self, content_type: str = "application/json") -> Dict[str, str]:
+        headers = {"Accept": "application/json", "Content-Type": content_type}
+        if self.config.token:
+            headers["Authorization"] = f"Bearer {self.config.token}"
+        return headers
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None,
+                params: Optional[Dict[str, str]] = None,
+                content_type: str = "application/json") -> dict:
+        if params:
+            path = f"{path}?{urlencode(params)}"
+        conn = self._connect(self.timeout)
+        try:
+            conn.request(
+                method, path,
+                body=json.dumps(body) if body is not None else None,
+                headers=self._headers(content_type),
+            )
+            resp = conn.getresponse()
+            payload = resp.read()
+            if resp.status == 404:
+                raise NotFound(_error_message(payload))
+            if resp.status == 409:
+                raise AlreadyExists(_error_message(payload))
+            if resp.status == 429:
+                raise EvictionBlocked(_error_message(payload))
+            if resp.status >= 400:
+                raise ApiError(resp.status, _error_message(payload))
+            return json.loads(payload) if payload else {}
+        finally:
+            conn.close()
+
+    def stream_watch(self, path: str, params: Dict[str, str],
+                     stop: threading.Event,
+                     conn_registry: Optional[List[Any]] = None) -> "Any":
+        """Yield watch events from a chunked watch response until the server
+        closes the stream or `stop` is set.  `conn_registry`, when given,
+        receives the live connection so the owner can close it to unblock a
+        reader parked in recv (watch connections have no timeout)."""
+        params = dict(params, watch="true")
+        full = f"{path}?{urlencode(params)}"
+        conn = self._connect(None)  # watches are long-lived
+        if conn_registry is not None:
+            conn_registry.append(conn)
+        try:
+            conn.request("GET", full, headers=self._headers())
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                raise ApiError(resp.status, _error_message(resp.read()))
+            buf = b""
+            while not stop.is_set():
+                chunk = resp.read1(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if line.strip():
+                        yield json.loads(line)
+        finally:
+            if conn_registry is not None:
+                try:
+                    conn_registry.remove(conn)
+                except ValueError:
+                    pass
+            conn.close()
+
+
+def _error_message(payload: bytes) -> str:
+    try:
+        return json.loads(payload).get("message", payload.decode(errors="replace"))
+    except (ValueError, AttributeError):
+        return payload.decode(errors="replace")
+
+
+# ---------------------------------------------------------------------------
+# the ClusterInterface backend
+
+
+class KubernetesCluster(ClusterInterface):
+    """Drives a real apiserver; the controller above it is unchanged."""
+
+    def __init__(self, config: Optional[KubeConfig] = None,
+                 namespace: Optional[str] = None) -> None:
+        self.config = config or default_config()
+        self.client = KubeClient(self.config)
+        # None = all namespaces (the reference's default, options.go:57-60)
+        self.namespace = namespace
+        self._job_handlers: List[WatchHandler] = []
+        self._pod_handlers: List[WatchHandler] = []
+        self._service_handlers: List[WatchHandler] = []
+        self._stop = threading.Event()
+        self._watch_threads: Dict[str, threading.Thread] = {}
+        self._watch_conns: List[Any] = []
+        self._event_seq = 0
+        self._identity = f"tpu-operator-{os.getpid()}"
+
+    # -- paths --
+
+    def _ns(self, namespace: Optional[str]) -> str:
+        return namespace or self.namespace or self.config.namespace
+
+    def _job_path(self, namespace: Optional[str], name: str = "") -> str:
+        base = (f"/apis/{constants.API_GROUP}/{constants.API_VERSION}"
+                f"/namespaces/{self._ns(namespace)}/{constants.PLURAL}")
+        return f"{base}/{name}" if name else base
+
+    @staticmethod
+    def _core_path(namespace: str, kind: str, name: str = "") -> str:
+        base = f"/api/v1/namespaces/{namespace}/{kind}"
+        return f"{base}/{name}" if name else base
+
+    # -- jobs --
+
+    def create_job(self, job: TPUJob) -> TPUJob:
+        raw = self.client.request(
+            "POST", self._job_path(job.metadata.namespace), body=job_to_k8s(job)
+        )
+        return serialization.job_from_dict(raw)
+
+    def get_job(self, namespace: str, name: str) -> TPUJob:
+        return serialization.job_from_dict(
+            self.client.request("GET", self._job_path(namespace, name))
+        )
+
+    def list_jobs(self, namespace: Optional[str] = None) -> List[TPUJob]:
+        if namespace or self.namespace:
+            raw = self.client.request("GET", self._job_path(namespace))
+        else:
+            raw = self.client.request(
+                "GET",
+                f"/apis/{constants.API_GROUP}/{constants.API_VERSION}/{constants.PLURAL}",
+            )
+        return [serialization.job_from_dict(item) for item in raw.get("items", [])]
+
+    def update_job(self, job: TPUJob) -> TPUJob:
+        # CR updates require metadata.resourceVersion; TPUJob doesn't carry
+        # one, so read-inject-PUT with one retry on a write conflict.
+        path = self._job_path(job.metadata.namespace, job.metadata.name)
+        body = job_to_k8s(job)
+        for attempt in (0, 1):
+            current = self.client.request("GET", path)
+            body["metadata"]["resourceVersion"] = (
+                current.get("metadata") or {}
+            ).get("resourceVersion", "")
+            try:
+                raw = self.client.request("PUT", path, body=body)
+                return serialization.job_from_dict(raw)
+            except AlreadyExists:  # 409 conflict: refetch and retry once
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
+
+    def update_job_status(self, namespace: str, name: str, status: JobStatus) -> TPUJob:
+        # Status subresource write (ref: UpdateJobStatusInApiServer,
+        # status.go:207-225); merge-patch avoids read-modify-write races.
+        raw = self.client.request(
+            "PATCH", f"{self._job_path(namespace, name)}/status",
+            body={"status": serialization.status_to_dict(status)},
+            content_type="application/merge-patch+json",
+        )
+        return serialization.job_from_dict(raw)
+
+    def delete_job(self, namespace: str, name: str) -> None:
+        self.client.request("DELETE", self._job_path(namespace, name))
+
+    # -- pods --
+
+    def create_pod(self, pod: Pod) -> Pod:
+        raw = self.client.request(
+            "POST", self._core_path(pod.metadata.namespace, "pods"),
+            body=pod_to_k8s(pod),
+        )
+        return pod_from_k8s(raw)
+
+    def get_pod(self, namespace: str, name: str) -> Pod:
+        return pod_from_k8s(
+            self.client.request("GET", self._core_path(namespace, "pods", name))
+        )
+
+    def list_pods(self, namespace: Optional[str] = None,
+                  selector: Optional[Dict[str, str]] = None) -> List[Pod]:
+        params = {}
+        if selector:
+            params["labelSelector"] = ",".join(f"{k}={v}" for k, v in selector.items())
+        if namespace or self.namespace:
+            path = self._core_path(self._ns(namespace), "pods")
+        else:
+            path = "/api/v1/pods"
+        raw = self.client.request("GET", path, params=params or None)
+        return [pod_from_k8s(item) for item in raw.get("items", [])]
+
+    def update_pod(self, pod: Pod) -> Pod:
+        """Write back what the control plane actually mutates on live pods:
+        labels/annotations (slice-id stamping, scheduler.py) and status
+        (the fake-slice-provider preemption path).  A whole-object PUT would
+        (a) be rejected — pod spec is immutable and our converter cannot
+        round-trip admission-injected fields — and (b) silently drop the
+        status, which is a subresource on real apiservers."""
+        path = self._core_path(pod.metadata.namespace, "pods", pod.metadata.name)
+        raw = self.client.request(
+            "PATCH", path,
+            body={"metadata": {
+                "labels": dict(pod.metadata.labels),
+                "annotations": dict(pod.metadata.annotations),
+            }},
+            content_type="application/merge-patch+json",
+        )
+        status_body = {"status": {
+            "phase": pod.status.phase.value,
+            "reason": pod.status.reason or None,
+            "message": pod.status.message or None,
+            "containerStatuses": [
+                {
+                    "name": cs.name,
+                    "restartCount": cs.restart_count,
+                    "state": (
+                        {"terminated": {"exitCode": cs.exit_code}}
+                        if cs.terminated and cs.exit_code is not None
+                        else {"running": {}} if cs.running else {}
+                    ),
+                }
+                for cs in pod.status.container_statuses
+            ] or None,
+        }}
+        try:
+            raw = self.client.request(
+                "PATCH", f"{path}/status", body=status_body,
+                content_type="application/merge-patch+json",
+            )
+        except (ApiError, NotFound) as err:
+            # Real clusters may deny pods/status to the operator (kubelet
+            # owns it); the metadata patch above already landed.
+            log.debug("pod status patch skipped: %s", err)
+        return pod_from_k8s(raw)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self.client.request("DELETE", self._core_path(namespace, "pods", name))
+
+    def evict_pod(self, namespace: str, name: str) -> None:
+        """PDB-guarded voluntary eviction (Eviction subresource; a 429 means
+        the budget blocks it -> EvictionBlocked, matching InMemoryCluster)."""
+        self.client.request(
+            "POST", f"{self._core_path(namespace, 'pods', name)}/eviction",
+            body={
+                "apiVersion": "policy/v1",
+                "kind": "Eviction",
+                "metadata": {"name": name, "namespace": namespace},
+            },
+        )
+
+    # -- services --
+
+    def create_service(self, svc: Service) -> Service:
+        raw = self.client.request(
+            "POST", self._core_path(svc.metadata.namespace, "services"),
+            body=service_to_k8s(svc),
+        )
+        return service_from_k8s(raw)
+
+    def list_services(self, namespace: Optional[str] = None,
+                      selector: Optional[Dict[str, str]] = None) -> List[Service]:
+        params = {}
+        if selector:
+            params["labelSelector"] = ",".join(f"{k}={v}" for k, v in selector.items())
+        raw = self.client.request(
+            "GET", self._core_path(self._ns(namespace), "services"),
+            params=params or None,
+        )
+        return [service_from_k8s(item) for item in raw.get("items", [])]
+
+    def delete_service(self, namespace: str, name: str) -> None:
+        self.client.request("DELETE", self._core_path(namespace, "services", name))
+
+    # -- podgroups / pdbs --
+
+    def _podgroup_path(self, namespace: str, name: str = "") -> str:
+        base = f"/apis/{PODGROUP_API}/namespaces/{namespace}/podgroups"
+        return f"{base}/{name}" if name else base
+
+    def create_podgroup(self, pg: PodGroup) -> PodGroup:
+        raw = self.client.request(
+            "POST", self._podgroup_path(pg.metadata.namespace),
+            body=podgroup_to_k8s(pg),
+        )
+        return podgroup_from_k8s(raw)
+
+    def get_podgroup(self, namespace: str, name: str) -> PodGroup:
+        return podgroup_from_k8s(
+            self.client.request("GET", self._podgroup_path(namespace, name))
+        )
+
+    def delete_podgroup(self, namespace: str, name: str) -> None:
+        self.client.request("DELETE", self._podgroup_path(namespace, name))
+
+    def _pdb_path(self, namespace: str, name: str = "") -> str:
+        base = f"/apis/policy/v1/namespaces/{namespace}/poddisruptionbudgets"
+        return f"{base}/{name}" if name else base
+
+    def create_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        raw = self.client.request(
+            "POST", self._pdb_path(pdb.metadata.namespace), body=pdb_to_k8s(pdb)
+        )
+        return pdb_from_k8s(raw)
+
+    def get_pdb(self, namespace: str, name: str) -> PodDisruptionBudget:
+        return pdb_from_k8s(
+            self.client.request("GET", self._pdb_path(namespace, name))
+        )
+
+    def update_pdb(self, pdb: PodDisruptionBudget) -> PodDisruptionBudget:
+        raw = self.client.request(
+            "PUT", self._pdb_path(pdb.metadata.namespace, pdb.metadata.name),
+            body=pdb_to_k8s(pdb),
+        )
+        return pdb_from_k8s(raw)
+
+    def delete_pdb(self, namespace: str, name: str) -> None:
+        self.client.request("DELETE", self._pdb_path(namespace, name))
+
+    # -- events --
+
+    def record_event(self, event: Event) -> None:
+        self._event_seq += 1
+        try:
+            self.client.request(
+                "POST", self._core_path(event.namespace, "events"),
+                body=event_to_k8s(
+                    event, f"{int(event.timestamp * 1000):x}.{self._event_seq}"
+                ),
+            )
+        except Exception as err:  # noqa: BLE001 — events are best-effort; a
+            # failed write (404 terminating namespace, socket error, ...)
+            # must never abort the reconcile/scheduling step that emitted it.
+            log.warning("event write failed: %s", err)
+
+    def list_events(self, namespace: Optional[str] = None,
+                    object_name: Optional[str] = None) -> List[Event]:
+        params = {}
+        if object_name:
+            params["fieldSelector"] = f"involvedObject.name={object_name}"
+        raw = self.client.request(
+            "GET", self._core_path(self._ns(namespace), "events"),
+            params=params or None,
+        )
+        return [event_from_k8s(item) for item in raw.get("items", [])]
+
+    # -- watches (the informer analogue) --
+
+    def watch_jobs(self, handler: WatchHandler) -> None:
+        self._job_handlers.append(handler)
+        self._ensure_watch(
+            "jobs",
+            f"/apis/{constants.API_GROUP}/{constants.API_VERSION}/{constants.PLURAL}"
+            if not (self.namespace or None)
+            else self._job_path(None),
+            serialization.job_from_dict,
+            self._job_handlers,
+        )
+
+    def watch_pods(self, handler: WatchHandler) -> None:
+        self._pod_handlers.append(handler)
+        path = ("/api/v1/pods" if not (self.namespace or None)
+                else self._core_path(self._ns(None), "pods"))
+        self._ensure_watch("pods", path, pod_from_k8s, self._pod_handlers)
+
+    def watch_services(self, handler: WatchHandler) -> None:
+        self._service_handlers.append(handler)
+        path = ("/api/v1/services" if not (self.namespace or None)
+                else self._core_path(self._ns(None), "services"))
+        self._ensure_watch("services", path, service_from_k8s, self._service_handlers)
+
+    def _ensure_watch(self, key: str, path: str,
+                      convert: Callable[[dict], Any],
+                      handlers: List[WatchHandler]) -> None:
+        if key in self._watch_threads:
+            return
+        thread = threading.Thread(
+            target=self._watch_loop, args=(path, convert, handlers),
+            daemon=True, name=f"k8s-watch-{key}",
+        )
+        self._watch_threads[key] = thread
+        thread.start()
+
+    def _watch_loop(self, path: str, convert: Callable[[dict], Any],
+                    handlers: List[WatchHandler]) -> None:
+        resource_version = ""
+        # ns/name -> last converted object: lets a relist after a stream gap
+        # emit synthetic DELETEDs for objects that vanished during the gap
+        # (informer cache-diff semantics) — gang release and terminal cleanup
+        # are driven purely by DELETED events.
+        known: Dict[str, Any] = {}
+        while not self._stop.is_set():
+            try:
+                if not resource_version:
+                    # List first: replay current state as ADDED / diff
+                    # against the cache, pin the resourceVersion.
+                    raw = self.client.request("GET", path)
+                    resource_version = (raw.get("metadata") or {}).get(
+                        "resourceVersion", ""
+                    )
+                    seen: Dict[str, Any] = {}
+                    for item in raw.get("items", []):
+                        obj = convert(item)
+                        obj_key = f"{obj.metadata.namespace}/{obj.metadata.name}"
+                        seen[obj_key] = obj
+                        etype = (EventType.MODIFIED if obj_key in known
+                                 else EventType.ADDED)
+                        self._dispatch(handlers, etype, obj)
+                    for gone_key in set(known) - set(seen):
+                        self._dispatch(handlers, EventType.DELETED, known[gone_key])
+                    known = seen
+                params = {"resourceVersion": resource_version,
+                          "allowWatchBookmarks": "true"}
+                for evt in self.client.stream_watch(
+                    path, params, self._stop, conn_registry=self._watch_conns
+                ):
+                    etype = evt.get("type", "")
+                    obj_raw = evt.get("object") or {}
+                    if etype == "BOOKMARK":
+                        resource_version = (obj_raw.get("metadata") or {}).get(
+                            "resourceVersion", resource_version
+                        )
+                        continue
+                    if etype == "ERROR":
+                        resource_version = ""  # 410 Gone -> relist
+                        break
+                    resource_version = (obj_raw.get("metadata") or {}).get(
+                        "resourceVersion", resource_version
+                    )
+                    mapping = {
+                        "ADDED": EventType.ADDED,
+                        "MODIFIED": EventType.MODIFIED,
+                        "DELETED": EventType.DELETED,
+                    }
+                    if etype in mapping:
+                        obj = convert(obj_raw)
+                        obj_key = f"{obj.metadata.namespace}/{obj.metadata.name}"
+                        if etype == "DELETED":
+                            known.pop(obj_key, None)
+                        else:
+                            known[obj_key] = obj
+                        self._dispatch(handlers, mapping[etype], obj)
+            except (OSError, ApiError, NotFound, ValueError) as err:
+                if self._stop.is_set():
+                    return
+                log.warning("watch %s error: %s; reconnecting", path, err)
+                resource_version = ""
+                self._stop.wait(1.0)
+
+    @staticmethod
+    def _dispatch(handlers: List[WatchHandler], etype: EventType, obj: Any) -> None:
+        for handler in list(handlers):
+            try:
+                handler(etype, obj)
+            except Exception:  # noqa: BLE001 — one handler must not kill the watch
+                log.exception("watch handler failed")
+
+    # -- leases (leader election) --
+
+    def try_acquire_lease(self, name: str, holder: str, ttl: float) -> bool:
+        """coordination.k8s.io Lease acquire/renew (the reference's
+        EndpointsLock semantics, server.go:53-58,159-184)."""
+        namespace = self._ns(None)
+        path = f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        now = time.time()
+        body = {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": {"name": name, "namespace": namespace},
+            "spec": {
+                "holderIdentity": holder,
+                "leaseDurationSeconds": int(ttl),
+                "renewTime": to_rfc3339(now).replace("Z", ".000000Z"),
+                "acquireTime": to_rfc3339(now).replace("Z", ".000000Z"),
+            },
+        }
+        try:
+            raw = self.client.request("GET", f"{path}/{name}")
+        except NotFound:
+            try:
+                self.client.request("POST", path, body=body)
+                return True
+            except (AlreadyExists, ApiError):
+                return False
+        spec = raw.get("spec") or {}
+        current_holder = spec.get("holderIdentity", "")
+        renew = from_rfc3339((spec.get("renewTime") or "").split(".")[0] + "Z")
+        duration = float(spec.get("leaseDurationSeconds") or ttl)
+        expired = renew is None or (now - renew) > duration
+        if current_holder and current_holder != holder and not expired:
+            return False
+        body["metadata"]["resourceVersion"] = (raw.get("metadata") or {}).get(
+            "resourceVersion", ""
+        )
+        try:
+            self.client.request("PUT", f"{path}/{name}", body=body)
+            return True
+        except (ApiError, AlreadyExists):
+            return False  # conflict: someone else renewed first
+
+    def close(self) -> None:
+        self._stop.set()
+        # Unblock watch threads parked in recv on timeout-less connections.
+        for conn in list(self._watch_conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+def default_config() -> KubeConfig:
+    """In-cluster when running as a Deployment, kubeconfig otherwise —
+    the reference's resolution order (server.go:94-99 KUBECONFIG override)."""
+    if (os.path.exists(os.path.join(SERVICE_ACCOUNT_DIR, "token"))
+            and "KUBECONFIG" not in os.environ):
+        return KubeConfig.in_cluster()
+    return KubeConfig.from_kubeconfig()

@@ -66,7 +66,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--enable-leader-election", action="store_true")
     parser.add_argument("--workdir", default=".tpujob-local",
                         help="local runtime workdir (logs, state)")
-    parser.add_argument("--runtime", choices=("local", "memory"), default="local")
+    parser.add_argument("--runtime", choices=("local", "memory", "k8s"),
+                        default="local",
+                        help="pod substrate: local processes, in-memory "
+                             "(tests), or a Kubernetes apiserver")
+    parser.add_argument("--kubeconfig", default=None,
+                        help="kubeconfig path for --runtime k8s (default: "
+                             "in-cluster service account, then $KUBECONFIG, "
+                             "then ~/.kube/config — ref: server.go:94-99)")
     return parser
 
 
@@ -159,11 +166,19 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
     log = tpulog.logger_for_key("server")
 
     if cluster is None:
-        cluster = (
-            LocalProcessCluster(workdir=args.workdir)
-            if args.runtime == "local"
-            else InMemoryCluster()
-        )
+        if args.runtime == "k8s":
+            from ..runtime.k8s import KubeConfig, KubernetesCluster
+
+            kube = (
+                KubeConfig.from_kubeconfig(args.kubeconfig)
+                if args.kubeconfig
+                else None  # in-cluster / $KUBECONFIG resolution
+            )
+            cluster = KubernetesCluster(kube, namespace=args.namespace or None)
+        elif args.runtime == "local":
+            cluster = LocalProcessCluster(workdir=args.workdir)
+        else:
+            cluster = InMemoryCluster()
 
     config = ReconcilerConfig(
         reconciler_sync_loop_period=args.resync_period,

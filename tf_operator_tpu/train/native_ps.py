@@ -24,6 +24,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..utils.native_build import load_native_lib
+from .ps import BasePSClient
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "ps_server.cpp"))
@@ -173,67 +174,29 @@ def _read_tensors(sock: socket.socket) -> Dict[str, np.ndarray]:
     return out
 
 
-class NativePSClient:
-    """Worker-side view over native PS shards; mirrors ps.PSClient.
+class NativePSClient(BasePSClient):
+    """Binary-protocol transport over the shared client shell (routing,
+    partial-push fan-out, shutdown live in ps.BasePSClient).
 
     Note the flat-vector difference from the Python transport: the wire
     carries shapeless float32 buffers, so pulled params come back 1-D and the
     caller reshapes against its local tree (ps.unflatten_params users already
     reshape via the model's init shapes)."""
 
-    def __init__(self, addresses: List[str], timeout: float = 30.0) -> None:
-        self.addresses = addresses
-        self.timeout = timeout
-        self._socks: List[Optional[socket.socket]] = [None] * len(addresses)
-        self._routes: Dict[str, int] = {}
-
-    def _sock(self, i: int) -> socket.socket:
-        if self._socks[i] is None:
-            host, _, port = self.addresses[i].rpartition(":")
-            self._socks[i] = socket.create_connection(
-                (host, int(port)), timeout=self.timeout
-            )
-        return self._socks[i]
-
     def _request(self, i: int, op: int, payload: bytes = b"") -> socket.socket:
         sock = self._sock(i)
         sock.sendall(_FRAME.pack(op, len(payload)) + payload)
         return sock
 
-    def pull(self) -> Dict[str, np.ndarray]:
-        merged: Dict[str, np.ndarray] = {}
-        for i in range(len(self.addresses)):
-            sock = self._request(i, _OP_PULL)
-            _version = _U64.unpack(_recv_exact(sock, 8))[0]
-            shard = _read_tensors(sock)
-            for name in shard:
-                self._routes[name] = i
-            merged.update(shard)
-        return merged
+    def _pull_shard(self, i: int) -> Dict[str, np.ndarray]:
+        sock = self._request(i, _OP_PULL)
+        _version = _U64.unpack(_recv_exact(sock, 8))[0]
+        return _read_tensors(sock)
 
-    def push(self, grads: Dict[str, np.ndarray]) -> None:
-        if not self._routes:
-            self.pull()
-        unknown = [n for n in grads if n not in self._routes]
-        if unknown:
-            raise KeyError(f"params not hosted by any PS shard: {unknown}")
-        by_shard: Dict[int, Dict[str, np.ndarray]] = {}
-        for name, grad in grads.items():
-            by_shard.setdefault(self._routes[name], {})[name] = grad
-        for i, mine in by_shard.items():
-            sock = self._request(i, _OP_PUSH, _pack_tensors(mine))
-            _U64.unpack(_recv_exact(sock, 8))
+    def _push_shard(self, i: int, grads: Dict[str, np.ndarray]) -> None:
+        sock = self._request(i, _OP_PUSH, _pack_tensors(grads))
+        _U64.unpack(_recv_exact(sock, 8))
 
-    def shutdown_servers(self) -> None:
-        for i in range(len(self.addresses)):
-            try:
-                sock = self._request(i, _OP_SHUTDOWN)
-                _recv_exact(sock, 8)
-            except (OSError, ConnectionError):
-                pass
-
-    def close(self) -> None:
-        for sock in self._socks:
-            if sock is not None:
-                sock.close()
-        self._socks = [None] * len(self.addresses)
+    def _shutdown_shard(self, i: int) -> None:
+        sock = self._request(i, _OP_SHUTDOWN)
+        _recv_exact(sock, 8)

@@ -108,8 +108,12 @@ class _PSHandler(socketserver.BaseRequestHandler):
             return
 
 
-class PSClient:
-    """Worker-side view over all PS shards."""
+class BasePSClient:
+    """Worker-side view over all PS shards — the transport-agnostic shell
+    (socket pool, pull-learned routing, partial-push fan-out, shutdown).
+    Subclasses supply the wire protocol via the three _shard hooks; the
+    pickle transport below and the binary one (train/native_ps.py) share
+    everything else."""
 
     def __init__(self, addresses: List[str], timeout: float = 30.0) -> None:
         self.addresses = addresses
@@ -117,6 +121,19 @@ class PSClient:
         self.timeout = timeout
         # name -> shard index, learned from pull(); authoritative routing.
         self._routes: Dict[str, int] = {}
+
+    # -- transport hooks --
+
+    def _pull_shard(self, i: int) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def _push_shard(self, i: int, grads: Dict[str, np.ndarray]) -> None:
+        raise NotImplementedError
+
+    def _shutdown_shard(self, i: int) -> None:
+        raise NotImplementedError
+
+    # -- shared behavior --
 
     def _sock(self, i: int) -> socket.socket:
         if self._socks[i] is None:
@@ -128,8 +145,7 @@ class PSClient:
     def pull(self) -> Dict[str, np.ndarray]:
         merged: Dict[str, np.ndarray] = {}
         for i in range(len(self.addresses)):
-            _send(self._sock(i), ("pull",))
-            shard, _version = _recv(self._sock(i))
+            shard = self._pull_shard(i)
             for name in shard:
                 self._routes[name] = i
             merged.update(shard)
@@ -149,14 +165,12 @@ class PSClient:
         for name, grad in grads.items():
             by_shard.setdefault(self._routes[name], {})[name] = grad
         for i, mine in by_shard.items():
-            _send(self._sock(i), ("push", mine))
-            _recv(self._sock(i))
+            self._push_shard(i, mine)
 
     def shutdown_servers(self) -> None:
         for i in range(len(self.addresses)):
             try:
-                _send(self._sock(i), ("shutdown",))
-                _recv(self._sock(i))
+                self._shutdown_shard(i)
             except (OSError, ConnectionError):
                 pass
 
@@ -165,6 +179,23 @@ class PSClient:
             if sock is not None:
                 sock.close()
         self._socks = [None] * len(self.addresses)
+
+
+class PSClient(BasePSClient):
+    """Pickle-protocol transport (matches ParameterServer above)."""
+
+    def _pull_shard(self, i: int) -> Dict[str, np.ndarray]:
+        _send(self._sock(i), ("pull",))
+        shard, _version = _recv(self._sock(i))
+        return shard
+
+    def _push_shard(self, i: int, grads: Dict[str, np.ndarray]) -> None:
+        _send(self._sock(i), ("push", grads))
+        _recv(self._sock(i))
+
+    def _shutdown_shard(self, i: int) -> None:
+        _send(self._sock(i), ("shutdown",))
+        _recv(self._sock(i))
 
 
 def flatten_params(params, prefix="") -> Dict[str, np.ndarray]:

@@ -70,9 +70,14 @@ def main(argv=None) -> int:
         from ..train import native_ps
 
         if not native_ps.native_ps_available():
-            print("native PS transport unavailable; falling back to python",
-                  flush=True)
-            native = False
+            # Hard failure, not a fallback: every replica chooses its
+            # transport independently, and a PS that silently fell back to
+            # pickle while the workers speak the binary protocol (or vice
+            # versa) just drops every connection with no diagnosis.
+            print("native PS transport unavailable (g++ build failed) and "
+                  "--transport native was requested; refusing to fall back "
+                  "per-process", flush=True)
+            return 2
 
     if ctx.replica_type == "ps":
         # Serve this shard until a worker sends shutdown (or we are reaped).
