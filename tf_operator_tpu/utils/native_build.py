@@ -15,28 +15,42 @@ import subprocess
 from typing import Optional
 
 
+def _build(src: str, lib: str, timeout: float) -> bool:
+    tmp = f"{lib}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src, "-lpthread"],
+            check=True, capture_output=True, timeout=timeout,
+        )
+        os.replace(tmp, lib)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
+
 def load_native_lib(src: str, lib: str, timeout: float = 120.0) -> Optional[ctypes.CDLL]:
     """Build `src` -> `lib` if missing/stale, then dlopen.  Returns None if
     the toolchain is unavailable or the build fails (callers fall back to
-    their Python reference implementation)."""
+    their Python reference implementation).
+
+    If dlopen of a pre-existing lib fails (wrong arch/glibc, truncated file),
+    rebuild from source once before giving up, so a bad cached artifact can
+    never permanently disable the native path while the toolchain works."""
     stale = not os.path.exists(lib) or (
         os.path.exists(src) and os.path.getmtime(src) > os.path.getmtime(lib)
     )
-    if stale:
-        tmp = f"{lib}.tmp.{os.getpid()}"
-        try:
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src, "-lpthread"],
-                check=True, capture_output=True, timeout=timeout,
-            )
-            os.replace(tmp, lib)
-        except (OSError, subprocess.SubprocessError):
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            return None
+    if stale and not _build(src, lib, timeout):
+        return None
     try:
         return ctypes.CDLL(lib)
     except OSError:
+        if os.path.exists(src) and _build(src, lib, timeout):
+            try:
+                return ctypes.CDLL(lib)
+            except OSError:
+                return None
         return None
