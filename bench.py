@@ -1,37 +1,221 @@
-"""Benchmark: ResNet-50 training throughput on the attached TPU.
+"""Benchmark harness — survives the flaky tunneled-TPU environment.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints exactly ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
 Baseline semantics (BASELINE.md): the reference publishes no numbers; the
 driver target is >= 90% of bare-XLA steps/sec for the same model/batch on
 the same chip.  So vs_baseline = framework_steps_per_sec / bare_xla_steps_per_sec,
 where the bare-XLA baseline is a hand-written train step with no framework
 abstractions (same math, same data).  >= 0.9 passes; ~1.0 means the framework
-adds no overhead.
+adds no overhead.  That ratio measures *framework overhead vs bare XLA* and is
+meaningful on any backend, so when the TPU tunnel is down (round 1: even
+`jax.devices()` hung for minutes) the harness falls back to CPU rather than
+producing nothing; the chosen platform is recorded in the output.
 
-Timing methodology: on the tunneled TPU platform used here,
+Resilience design (VERDICT.md round-1 item #1):
+- The parent process never imports jax.  All jax work happens in child
+  subprocesses with hard wall-clock timeouts, so a wedged backend init can
+  never hang the bench.
+- Backend probe: a trivial `jax.devices()` + tiny matmul child with
+  bounded retries decides TPU vs CPU before any expensive compile starts.
+- Batch ladder: on child failure/timeout the batch size steps down
+  (128 -> 32 -> 8) so *some* number lands even on a sick chip.
+- Structured output always: on total failure the single JSON line carries
+  `error` + `stage` instead of a traceback.
+
+Also measured (BASELINE.md's other target, <90 s time-to-all-Running): a
+control-plane child submits a ResNet-shaped 4-worker TPUJob on the real
+LocalProcessCluster runtime and reports submit->all-replicas-Running seconds
+as `time_to_all_running_sec`.
+
+Timing methodology (throughput child): on the tunneled TPU platform,
 `block_until_ready` does NOT synchronize (measured: 8192^3 matmuls "complete"
-in 25us of host time — 280x over the chip's roofline — while a device_get
-after the same chain takes the real 55ms/matmul).  The only reliable sync is
-a device->host transfer.  So each measured run is ONE compiled region — the
-step scanned `lax.scan`-style over STEPS iterations — ended by fetching
-scalars that depend on the whole chain.  This also amortizes the ~ms-scale
-per-call tunnel dispatch, which would otherwise dominate and make the
-comparison measure RPC overhead instead of compute.
+in 25us of host time while a device_get after the same chain takes the real
+55ms/matmul).  The only reliable sync is a device->host transfer.  So each
+measured run is ONE compiled region — the step scanned `lax.scan`-style over
+STEPS iterations — ended by fetching scalars that depend on the whole chain.
+This also amortizes the ~ms-scale per-call tunnel dispatch.
+
+Env knobs: BENCH_MODEL (resnet|lm), BENCH_BATCH, BENCH_STEPS, BENCH_IMAGE,
+BENCH_SEQ, BENCH_FORCE_CPU=1, BENCH_PROBE_TIMEOUT, BENCH_CHILD_TIMEOUT,
+BENCH_SKIP_CONTROL_PLANE=1.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
-BATCH = int(os.environ.get("BENCH_BATCH", "128"))
-IMAGE = 224
-STEPS = int(os.environ.get("BENCH_STEPS", "20"))
+MODEL = os.environ.get("BENCH_MODEL", "resnet")
+PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "120"))
+CHILD_TIMEOUT = float(os.environ.get("BENCH_CHILD_TIMEOUT", "1200"))
 
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices();"
+    "x = jnp.ones((128, 128));"
+    "v = jax.device_get((x @ x).sum());"
+    "print('PROBE_OK', d[0].platform, len(d))"
+)
+
+
+# ---------------------------------------------------------------------------
+# Parent: orchestration (no jax imports here)
+# ---------------------------------------------------------------------------
+
+def _run(cmd, env_extra, timeout):
+    """Run a child; return (rc, stdout, stderr_tail). rc=-9 on timeout."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    env.setdefault("PYTHONPATH", REPO)
+    try:
+        proc = subprocess.run(
+            cmd, cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=timeout,
+        )
+        return proc.returncode, proc.stdout, proc.stderr[-2000:]
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        return -9, out, f"timeout after {timeout}s"
+
+
+def _last_json(stdout: str):
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except (ValueError, TypeError):
+                continue
+    return None
+
+
+def _probe_backend(stages):
+    """Decide the platform: 'tpu'-family if the real backend answers, else cpu."""
+    if os.environ.get("BENCH_FORCE_CPU"):
+        stages.append({"stage": "probe", "note": "BENCH_FORCE_CPU set"})
+        return None
+    for attempt in range(3):
+        t0 = time.time()
+        rc, out, err = _run([sys.executable, "-c", _PROBE_SRC], {}, PROBE_TIMEOUT)
+        dt = round(time.time() - t0, 1)
+        for line in out.splitlines():
+            if line.startswith("PROBE_OK"):
+                _, platform, n = line.split()
+                stages.append({"stage": "probe", "attempt": attempt, "ok": True,
+                               "platform": platform, "devices": int(n), "sec": dt})
+                if platform == "cpu":
+                    # jax came up but only on CPU (libtpu missing/broken):
+                    # take the small-shape CPU fallback, not the full-size
+                    # TPU configuration on a CPU backend.
+                    return None
+                return platform
+        stages.append({"stage": "probe", "attempt": attempt, "ok": False,
+                       "sec": dt, "err": err[-300:]})
+        time.sleep(2.0)
+    return None
+
+
+def _throughput(platform, stages):
+    """Run the throughput child, stepping down the batch ladder on failure."""
+    if platform is not None:
+        start = int(os.environ.get("BENCH_BATCH", "128"))
+        # only step DOWN from the starting batch — a larger rung can't
+        # succeed where a smaller one failed
+        ladder = [start] + [b for b in (32, 8) if b < start]
+        base_env = {}
+    else:
+        # CPU fallback: FIXED small shapes so compile+run stay in budget —
+        # deliberately ignoring any TPU-sized BENCH_* the user exported
+        # (override with BENCH_CPU_BATCH only).  NOTE: JAX_PLATFORMS=cpu env
+        # is NOT honored here — the sandbox's sitecustomize re-prepends the
+        # axon platform — so the child forces the platform in-process via
+        # TPUJOB_FORCE_PLATFORM (workloads/runner.apply_forced_platform).
+        ladder = [int(os.environ.get("BENCH_CPU_BATCH", "4"))]
+        base_env = {
+            "TPUJOB_FORCE_PLATFORM": "cpu",
+            "BENCH_IMAGE": "64",
+            "BENCH_SEQ": "256",
+            "BENCH_STEPS": "6",
+            "BENCH_LM_VOCAB": "8192",
+            "BENCH_LM_LAYERS": "2",
+            "BENCH_LM_HEADS": "4",
+            "BENCH_LM_DMODEL": "256",
+            "BENCH_LM_DFF": "1024",
+        }
+    for batch in ladder:
+        env = dict(base_env, BENCH_BATCH=str(batch))
+        t0 = time.time()
+        rc, out, err = _run(
+            [sys.executable, os.path.abspath(__file__), "--child-throughput"],
+            env, CHILD_TIMEOUT,
+        )
+        dt = round(time.time() - t0, 1)
+        parsed = _last_json(out)
+        stages.append({"stage": "throughput", "batch": batch, "rc": rc,
+                       "sec": dt, "ok": parsed is not None,
+                       **({} if parsed else {"err": err[-300:]})})
+        if parsed is not None:
+            parsed["platform"] = platform or "cpu"
+            return parsed
+    return None
+
+
+def _control_plane(stages):
+    """Submit→all-Running seconds on the LocalProcessCluster runtime."""
+    if os.environ.get("BENCH_SKIP_CONTROL_PLANE"):
+        return None
+    t0 = time.time()
+    rc, out, err = _run(
+        [sys.executable, os.path.abspath(__file__), "--child-control-plane"],
+        {"TPUJOB_FORCE_PLATFORM": "cpu"}, 240,
+    )
+    parsed = _last_json(out)
+    ok = parsed is not None and "time_to_all_running_sec" in parsed
+    entry = {"stage": "control_plane", "rc": rc,
+             "sec": round(time.time() - t0, 1), "ok": ok}
+    if not ok:
+        entry["err"] = (parsed or {}).get("error") or err[-300:]
+    stages.append(entry)
+    return parsed if ok else None
+
+
+def orchestrate() -> None:
+    stages = []
+    result = None
+    try:
+        platform = _probe_backend(stages)
+        result = _throughput(platform, stages)
+    except Exception as e:  # noqa: BLE001 — the one JSON line must still print
+        stages.append({"stage": "orchestrator", "err": repr(e)[:300]})
+    cp = None
+    try:
+        cp = _control_plane(stages)
+    except Exception as e:  # noqa: BLE001
+        stages.append({"stage": "control_plane", "err": repr(e)[:300]})
+
+    if result is None:
+        result = {
+            "metric": f"{MODEL}_train_throughput",
+            "value": 0.0,
+            "unit": "images/sec" if MODEL == "resnet" else "tokens/sec",
+            "vs_baseline": 0.0,
+            "error": "all bench stages failed",
+        }
+    if cp and "time_to_all_running_sec" in cp:
+        result["time_to_all_running_sec"] = cp["time_to_all_running_sec"]
+    result["stages"] = stages
+    print(json.dumps(result))
+
+
+# ---------------------------------------------------------------------------
+# Child: throughput (the only process that compiles the model)
+# ---------------------------------------------------------------------------
 
 def _tree_scalar(tree):
     """A cheap f32 scalar depending on every leaf (defeats dead-code elim)."""
@@ -46,7 +230,7 @@ def _tree_scalar(tree):
     return sum(leaves) if leaves else jnp.float32(0)
 
 
-def _throughput(raw_step, state, batch, steps: int) -> float:
+def _steps_per_sec(raw_step, state, batch, steps: int) -> float:
     """steps/sec for `raw_step` scanned inside one jit, synced via device_get."""
     import jax
     from jax import lax
@@ -70,70 +254,198 @@ def _throughput(raw_step, state, batch, steps: int) -> float:
     return steps / (time.perf_counter() - t0)
 
 
-def main() -> None:
+def child_throughput() -> None:
+    from tf_operator_tpu.workloads.runner import apply_forced_platform
+
+    apply_forced_platform()  # TPUJOB_FORCE_PLATFORM=cpu on the fallback path
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
 
-    from tf_operator_tpu.models.resnet import ResNet50
+    batch_size = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+
     from tf_operator_tpu.train.state import create_train_state
-    from tf_operator_tpu.train.step import classification_loss_fn, make_train_step
+    from tf_operator_tpu.train.step import make_train_step
 
     rng = np.random.RandomState(0)
-    images = jnp.asarray(rng.randn(BATCH, IMAGE, IMAGE, 3), jnp.bfloat16)
-    labels = jnp.asarray(rng.randint(0, 1000, BATCH), jnp.int32)
-    batch = {"x": images, "label": labels}
-
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
     tx = optax.sgd(0.1, momentum=0.9)
 
-    # --- framework path: the raw (unjitted) framework step under one scan ---
-    state = create_train_state(
-        jax.random.PRNGKey(0), model, tx, jnp.zeros((2, IMAGE, IMAGE, 3), jnp.bfloat16),
-        init_kwargs={"train": True},
-    )
-    fw_raw = make_train_step(
-        classification_loss_fn(model.apply, has_batch_stats=True,
-                               model_kwargs={"train": True}),
-        has_batch_stats=True,
-        jit=False,
-    )
-    fw_sps = _throughput(lambda s, b: fw_raw(s, b), state, batch, STEPS)
-
-    # --- bare-XLA baseline: same math, no framework ---
-    variables = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((2, IMAGE, IMAGE, 3), jnp.bfloat16), train=True
-    )
-    params, batch_stats = variables["params"], variables["batch_stats"]
-    opt_state = tx.init(params)
-
-    def loss_fn(p, bs, b):
-        logits, updates = model.apply(
-            {"params": p, "batch_stats": bs}, b["x"], train=True,
-            mutable=["batch_stats"],
+    if MODEL == "lm":
+        from tf_operator_tpu.models.transformer import (
+            TransformerConfig, TransformerLM,
         )
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        ll = jnp.take_along_axis(logp, b["label"][..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll), updates["batch_stats"]
+        from tf_operator_tpu.train.step import lm_loss_fn
 
-    def bare_raw(carry, b):
-        p, bs, os_ = carry
-        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(p, bs, b)
-        updates, new_os = tx.update(grads, os_, p)
-        new_p = optax.apply_updates(p, updates)
-        return (new_p, new_bs, new_os), {"loss": loss}
+        seq = int(os.environ.get("BENCH_SEQ", "1024"))
+        cfg = TransformerConfig(
+            vocab_size=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
+            num_layers=int(os.environ.get("BENCH_LM_LAYERS", "12")),
+            num_heads=int(os.environ.get("BENCH_LM_HEADS", "12")),
+            d_model=int(os.environ.get("BENCH_LM_DMODEL", "768")),
+            d_ff=int(os.environ.get("BENCH_LM_DFF", "3072")),
+            max_len=seq, causal=True, dtype=jnp.bfloat16,
+        )
+        model = TransformerLM(cfg)
+        tokens = jnp.asarray(
+            rng.randint(0, cfg.vocab_size, (batch_size, seq + 1)), jnp.int32
+        )
+        batch = {"tokens": tokens}
+        example = tokens[:2, :-1]
+        state = create_train_state(jax.random.PRNGKey(0), model, tx, example)
+        fw_raw = make_train_step(lm_loss_fn(model.apply), jit=False)
 
-    bare_sps = _throughput(bare_raw, (params, batch_stats, opt_state), batch, STEPS)
+        def bare_loss(p, b):
+            logits = model.apply({"params": p}, b["tokens"][:, :-1])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            ll = jnp.take_along_axis(
+                logp, b["tokens"][:, 1:][..., None], axis=-1
+            )[..., 0]
+            return -jnp.mean(ll)
 
-    images_per_sec = fw_sps * BATCH
+        params = model.init(jax.random.PRNGKey(0), example)["params"]
+        opt_state = tx.init(params)
+
+        def bare_raw(carry, b):
+            p, os_ = carry
+            loss, grads = jax.value_and_grad(bare_loss)(p, b)
+            updates, new_os = tx.update(grads, os_, p)
+            return (optax.apply_updates(p, updates), new_os), {"loss": loss}
+
+        bare_state = (params, opt_state)
+        unit, per_step = "tokens/sec", batch_size * seq
+        metric = f"lm_train_tokens_per_sec_bf16_b{batch_size}_t{seq}"
+    else:
+        from tf_operator_tpu.models.resnet import ResNet50
+        from tf_operator_tpu.train.step import classification_loss_fn
+
+        image = int(os.environ.get("BENCH_IMAGE", "224"))
+        images = jnp.asarray(
+            rng.randn(batch_size, image, image, 3), jnp.bfloat16
+        )
+        labels = jnp.asarray(rng.randint(0, 1000, batch_size), jnp.int32)
+        batch = {"x": images, "label": labels}
+        model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+        example = jnp.zeros((2, image, image, 3), jnp.bfloat16)
+        state = create_train_state(
+            jax.random.PRNGKey(0), model, tx, example,
+            init_kwargs={"train": True},
+        )
+        fw_raw = make_train_step(
+            classification_loss_fn(model.apply, has_batch_stats=True,
+                                   model_kwargs={"train": True}),
+            has_batch_stats=True,
+            jit=False,
+        )
+
+        variables = model.init(jax.random.PRNGKey(0), example, train=True)
+        params, batch_stats = variables["params"], variables["batch_stats"]
+        opt_state = tx.init(params)
+
+        def bare_loss(p, bs, b):
+            logits, updates = model.apply(
+                {"params": p, "batch_stats": bs}, b["x"], train=True,
+                mutable=["batch_stats"],
+            )
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            ll = jnp.take_along_axis(logp, b["label"][..., None], axis=-1)[..., 0]
+            return -jnp.mean(ll), updates["batch_stats"]
+
+        def bare_raw(carry, b):
+            p, bs, os_ = carry
+            (loss, new_bs), grads = jax.value_and_grad(
+                bare_loss, has_aux=True
+            )(p, bs, b)
+            updates, new_os = tx.update(grads, os_, p)
+            return (optax.apply_updates(p, updates), new_bs, new_os), {"loss": loss}
+
+        bare_state = (params, batch_stats, opt_state)
+        unit, per_step = "images/sec", batch_size
+        metric = f"resnet50_train_images_per_sec_bf16_b{batch_size}_i{image}"
+
+    fw_sps = _steps_per_sec(lambda s, b: fw_raw(s, b), state, batch, steps)
+    bare_sps = _steps_per_sec(bare_raw, bare_state, batch, steps)
+
     print(json.dumps({
-        "metric": f"resnet50_train_images_per_sec_bf16_b{BATCH}",
-        "value": round(images_per_sec, 2),
-        "unit": "images/sec",
+        "metric": metric,
+        "value": round(fw_sps * per_step, 2),
+        "unit": unit,
         "vs_baseline": round(fw_sps / bare_sps, 4),
     }))
 
 
+# ---------------------------------------------------------------------------
+# Child: control plane (time-to-all-Running on the local process runtime)
+# ---------------------------------------------------------------------------
+
+def child_control_plane() -> None:
+    import tempfile
+
+    from tf_operator_tpu.api.core import (
+        Container, ObjectMeta, PodPhase, PodTemplateSpec,
+    )
+    from tf_operator_tpu.api.constants import LABEL_JOB_NAME
+    from tf_operator_tpu.api.types import (
+        ReplicaSpec, ReplicaType, TPUJob, TPUJobSpec,
+    )
+    from tf_operator_tpu.controller.controller import TPUJobController
+    from tf_operator_tpu.runtime.local import LocalProcessCluster
+    from tf_operator_tpu.sdk.client import TPUJobClient
+
+    replicas = int(os.environ.get("BENCH_CP_REPLICAS", "4"))
+    workdir = tempfile.mkdtemp(prefix="bench-cp-")
+    cluster = LocalProcessCluster(workdir=workdir)
+    controller = TPUJobController(cluster, threadiness=2,
+                                  resolver=cluster.resolver)
+    controller.start()
+    client = TPUJobClient(cluster)
+    try:
+        # ResNet-shaped TFJob (BASELINE.md: examples/v1 ResNet-50): N workers;
+        # the container just has to reach Running, so it idles.
+        job = TPUJob(
+            metadata=ObjectMeta(name="bench-cp"),
+            spec=TPUJobSpec(replica_specs={
+                ReplicaType.WORKER: ReplicaSpec(
+                    replicas=replicas,
+                    template=PodTemplateSpec(containers=[Container(
+                        name="tensorflow", image="local",
+                        command=[sys.executable, "-c",
+                                 "import time; time.sleep(120)"],
+                    )]),
+                )
+            }),
+        )
+        t0 = time.perf_counter()
+        client.create(job)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            pods = cluster.list_pods(
+                selector={LABEL_JOB_NAME: "bench-cp"})
+            if (len(pods) == replicas
+                    and all(p.status.phase == PodPhase.RUNNING for p in pods)
+                    and client.is_job_running("bench-cp")):
+                break
+            time.sleep(0.02)
+        else:
+            print(json.dumps({"error": "never reached all-Running"}))
+            return
+        dt = time.perf_counter() - t0
+        print(json.dumps({"time_to_all_running_sec": round(dt, 3),
+                          "replicas": replicas}))
+    finally:
+        try:
+            client.delete("bench-cp")
+        except Exception:  # noqa: BLE001
+            pass
+        controller.stop()
+        cluster.close()
+
+
 if __name__ == "__main__":
-    main()
+    if "--child-throughput" in sys.argv:
+        child_throughput()
+    elif "--child-control-plane" in sys.argv:
+        child_control_plane()
+    else:
+        orchestrate()
