@@ -21,7 +21,7 @@ from urllib.parse import parse_qs, urlsplit
 _COLLECTION_RE = re.compile(
     r"^/(?:api/v1|apis/(?P<group>[^/]+/[^/]+))"
     r"(?:/namespaces/(?P<ns>[^/]+))?/(?P<kind>[a-z]+)"
-    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status|eviction))?$"
+    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status|eviction|log))?$"
 )
 
 
@@ -71,6 +71,19 @@ class FakeApiServer:
                 kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
                 if params.get("watch") == "true":
                     return self._serve_watch(kind, ns, params)
+                if m.group("sub") == "log":
+                    with server._lock:
+                        obj = server._get(kind, ns, name)
+                        if obj is None:
+                            return self._error(404, f"{kind} {ns}/{name} not found")
+                        text = obj.get("_log", "")
+                    data = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return None
                 with server._lock:
                     if name:
                         obj = server._get(kind, ns, name)
@@ -247,6 +260,14 @@ class FakeApiServer:
                 raise KeyError(name)
             pod["status"] = status
             self._put("pods", namespace, name, pod)
+
+    def set_pod_log(self, namespace: str, name: str, text: str) -> None:
+        """Kubelet stand-in: stash container log text served by GET .../log."""
+        with self._lock:
+            pod = self._get("pods", namespace, name)
+            if pod is None:
+                raise KeyError(name)
+            pod["_log"] = text
 
     def objects(self, kind: str, namespace: str = "default") -> Dict[str, dict]:
         with self._lock:

@@ -211,3 +211,71 @@ def test_eviction_respects_budget(k8s):
     cluster.evict_pod("default", "ev-pod")
     with pytest.raises(NotFound):
         cluster.get_pod("default", "ev-pod")
+
+
+def _simple_pod(name):
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodTemplateSpec(containers=[Container(name="tensorflow", image="i")]),
+    )
+
+
+def test_pod_logs(k8s):
+    """GET pods/<name>/log wired through ClusterInterface + SDK get_logs
+    (ref: read_namespaced_pod_log, tf_job_client.py:340-356)."""
+    from tf_operator_tpu.sdk.client import TPUJobClient
+
+    server, cluster = k8s
+    job = new_tpujob(worker=1, name="log-job")
+    cluster.create_job(job)
+    from tf_operator_tpu.controller.controller import TPUJobController
+
+    TPUJobController(cluster).sync_job("default/log-job")
+    server.set_pod_log("default", "log-job-worker-0", "step 1\nstep 2\n")
+    assert cluster.pod_logs("default", "log-job-worker-0") == "step 1\nstep 2\n"
+    logs = TPUJobClient(cluster).get_logs("log-job")
+    assert logs == {"log-job-worker-0": "step 1\nstep 2\n"}
+
+
+def test_patch_job_is_server_side_merge(k8s):
+    """SDK dict patch -> one apiserver-side merge-patch, no read-modify-write
+    (the reference SDK's patch, tf_job_client.py:114-136)."""
+    from tf_operator_tpu.sdk.client import TPUJobClient
+
+    server, cluster = k8s
+    cluster.create_job(new_tpujob(worker=2, name="patch-job"))
+    client = TPUJobClient(cluster)
+    patched = client.patch(
+        "patch-job",
+        {"spec": {"replicaSpecs": {"Worker": {"replicas": 3}}}},
+    )
+    assert patched.spec.replica_specs[ReplicaType.WORKER].replicas == 3
+    # the write was a PATCH on the job path, not GET+PUT
+    writes = [(m, p) for (m, p) in server.requests
+              if "patch-job" in p and m in ("PATCH", "PUT")]
+    assert writes and all(m == "PATCH" for m, _ in writes)
+
+
+def test_update_pod_skips_stale_status_writeback(k8s):
+    """Annotation-only update_pod must not write back a stale phase the
+    kubelet has since advanced (advisor finding: slice-id stamping vs a
+    racing phase transition)."""
+    server, cluster = k8s
+    cluster.create_pod(_simple_pod("stamp-pod"))
+    stale = cluster.get_pod("default", "stamp-pod")  # snapshot: Pending
+    # kubelet advances the pod before the controller's patch lands
+    server.set_pod_status("default", "stamp-pod",
+                          {"phase": "Running",
+                           "containerStatuses": [
+                               {"name": "tensorflow", "state": {"running": {}}}]})
+    stale.metadata.annotations["tpu-operator.dev/slice-id"] = "slice-0"
+    cluster.update_pod(stale)
+    after = cluster.get_pod("default", "stamp-pod")
+    assert after.status.phase == PodPhase.RUNNING  # not regressed to Pending
+    assert after.metadata.annotations["tpu-operator.dev/slice-id"] == "slice-0"
+    # but an intentional status write (fault injection) still lands
+    preempt = cluster.get_pod("default", "stamp-pod")
+    preempt.status.phase = PodPhase.FAILED
+    preempt.status.reason = "Preempted"
+    cluster.update_pod_status(preempt)
+    assert cluster.get_pod("default", "stamp-pod").status.phase == PodPhase.FAILED

@@ -68,6 +68,15 @@ class ClusterInterface:
     def get_pod(self, namespace: str, name: str) -> Pod: ...
     def list_pods(self, namespace: Optional[str] = None, selector: Optional[Dict[str, str]] = None) -> List[Pod]: ...
     def update_pod(self, pod: Pod) -> Pod: ...
+
+    def update_pod_status(self, pod: Pod) -> Pod:
+        """Write `pod`'s status explicitly (fault injection / fake-kubelet
+        paths).  In-process substrates store whole objects so the default
+        delegates to update_pod; the k8s backend overrides this because
+        status is a separate subresource there and a plain update_pod must
+        never write back a phase the kubelet owns."""
+        return self.update_pod(pod)
+
     def delete_pod(self, namespace: str, name: str) -> None: ...
 
     # services

@@ -27,7 +27,7 @@ import os
 import ssl
 import threading
 import time
-from http.client import HTTPConnection, HTTPSConnection
+from http.client import HTTPConnection, HTTPException, HTTPSConnection
 from typing import Any, Callable, Dict, List, Optional
 from urllib.parse import urlencode, urlsplit
 
@@ -498,7 +498,10 @@ class KubeClient:
     def request(self, method: str, path: str,
                 body: Optional[dict] = None,
                 params: Optional[Dict[str, str]] = None,
-                content_type: str = "application/json") -> dict:
+                content_type: str = "application/json",
+                raw: bool = False):
+        """JSON request/response; raw=True returns the body as text instead
+        (the pod log endpoint serves text/plain, not JSON)."""
         if params:
             path = f"{path}?{urlencode(params)}"
         conn = self._connect(self.timeout)
@@ -518,6 +521,8 @@ class KubeClient:
                 raise EvictionBlocked(_error_message(payload))
             if resp.status >= 400:
                 raise ApiError(resp.status, _error_message(payload))
+            if raw:
+                return payload.decode(errors="replace")
             return json.loads(payload) if payload else {}
         finally:
             conn.close()
@@ -653,6 +658,17 @@ class KubernetesCluster(ClusterInterface):
         )
         return serialization.job_from_dict(raw)
 
+    def patch_job(self, namespace: str, name: str, patch: Dict[str, Any]) -> TPUJob:
+        """JSON-merge-patch a TPUJob (the reference SDK's patch semantics,
+        tf_job_client.py:114-136) — a single apiserver-side merge, so
+        concurrent patches to different fields can't lose updates the way
+        read-modify-write PUT does."""
+        raw = self.client.request(
+            "PATCH", self._job_path(namespace, name), body=patch,
+            content_type="application/merge-patch+json",
+        )
+        return serialization.job_from_dict(raw)
+
     def delete_job(self, namespace: str, name: str) -> None:
         self.client.request("DELETE", self._job_path(namespace, name))
 
@@ -683,12 +699,13 @@ class KubernetesCluster(ClusterInterface):
         return [pod_from_k8s(item) for item in raw.get("items", [])]
 
     def update_pod(self, pod: Pod) -> Pod:
-        """Write back what the control plane actually mutates on live pods:
-        labels/annotations (slice-id stamping, scheduler.py) and status
-        (the fake-slice-provider preemption path).  A whole-object PUT would
-        (a) be rejected — pod spec is immutable and our converter cannot
-        round-trip admission-injected fields — and (b) silently drop the
-        status, which is a subresource on real apiservers."""
+        """Metadata-only write (labels/annotations — slice-id stamping,
+        scheduler.py).  A whole-object PUT would be rejected — pod spec is
+        immutable and our converter cannot round-trip admission-injected
+        fields — and the kubelet owns status, so writing the caller's
+        snapshot of it back here would regress a phase that advanced between
+        the caller's read and this patch.  Callers that mean to write status
+        (fault injection) use update_pod_status."""
         path = self._core_path(pod.metadata.namespace, "pods", pod.metadata.name)
         raw = self.client.request(
             "PATCH", path,
@@ -698,6 +715,13 @@ class KubernetesCluster(ClusterInterface):
             }},
             content_type="application/merge-patch+json",
         )
+        return pod_from_k8s(raw)
+
+    def update_pod_status(self, pod: Pod) -> Pod:
+        """Explicit status write via the pods/status subresource (the
+        fake-slice-provider preemption path marking victims Failed)."""
+        raw = pod_to_k8s(self.update_pod(pod))  # metadata first
+        path = self._core_path(pod.metadata.namespace, "pods", pod.metadata.name)
         status_body = {"status": {
             "phase": pod.status.phase.value,
             "reason": pod.status.reason or None,
@@ -728,6 +752,14 @@ class KubernetesCluster(ClusterInterface):
 
     def delete_pod(self, namespace: str, name: str) -> None:
         self.client.request("DELETE", self._core_path(namespace, "pods", name))
+
+    def pod_logs(self, namespace: str, name: str) -> str:
+        """Container log retrieval (ref SDK get_logs: read_namespaced_pod_log,
+        tf_job_client.py:340-356) — makes `cli logs` / SDK get_logs work on
+        the k8s runtime, not just local/in-memory substrates."""
+        return self.client.request(
+            "GET", f"{self._core_path(namespace, 'pods', name)}/log", raw=True
+        )
 
     def evict_pod(self, namespace: str, name: str) -> None:
         """PDB-guarded voluntary eviction (Eviction subresource; a 429 means
@@ -865,8 +897,14 @@ class KubernetesCluster(ClusterInterface):
     def _ensure_watch(self, key: str, path: str,
                       convert: Callable[[dict], Any],
                       handlers: List[WatchHandler]) -> None:
-        if key in self._watch_threads:
+        existing = self._watch_threads.get(key)
+        if existing is not None and existing.is_alive():
             return
+        if existing is not None:
+            # A watch thread died (it shouldn't — the loop retries on any
+            # exception — but a dead informer silently blinds the controller,
+            # so supervise anyway; client-go informers always reconnect).
+            log.warning("watch thread %s found dead; restarting", key)
         thread = threading.Thread(
             target=self._watch_loop, args=(path, convert, handlers),
             daemon=True, name=f"k8s-watch-{key}",
@@ -933,10 +971,21 @@ class KubernetesCluster(ClusterInterface):
                         else:
                             known[obj_key] = obj
                         self._dispatch(handlers, mapping[etype], obj)
-            except (OSError, ApiError, NotFound, ValueError) as err:
+            except (OSError, HTTPException, ApiError, NotFound, ValueError) as err:
+                # HTTPException covers IncompleteRead/BadStatusLine from a
+                # mid-chunk truncated watch stream — without it the daemon
+                # thread dies and the controller silently stops seeing events.
                 if self._stop.is_set():
                     return
                 log.warning("watch %s error: %s; reconnecting", path, err)
+                resource_version = ""
+                self._stop.wait(1.0)
+            except Exception as err:  # noqa: BLE001 — last resort: a watch
+                # loop must never die while the cluster is open (informer
+                # contract); relist and keep going.
+                if self._stop.is_set():
+                    return
+                log.exception("watch %s unexpected error: %s; relisting", path, err)
                 resource_version = ""
                 self._stop.wait(1.0)
 

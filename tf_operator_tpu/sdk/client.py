@@ -9,7 +9,7 @@ ClusterInterface instead of the k8s CustomObjects REST API.
 from __future__ import annotations
 
 import time
-from typing import Callable, Iterable, List, Optional
+from typing import Callable, Iterable, List, Optional, Union
 
 from ..api import constants
 from ..api.types import JobConditionType, TPUJob
@@ -17,6 +17,20 @@ from ..runtime import conditions
 from ..runtime.cluster import ClusterInterface
 
 TERMINAL_CONDITIONS = ("Succeeded", "Failed")
+
+
+def _json_merge_patch(base: dict, patch: dict) -> dict:
+    """RFC 7386 merge patch (client-side fallback for backends without a
+    server-side PATCH verb)."""
+    out = dict(base)
+    for key, value in patch.items():
+        if isinstance(value, dict) and isinstance(out.get(key), dict):
+            out[key] = _json_merge_patch(out[key], value)
+        elif value is None:
+            out.pop(key, None)
+        else:
+            out[key] = value
+    return out
 
 
 class TimeoutError_(TimeoutError):
@@ -40,11 +54,29 @@ class TPUJobClient:
     def get(self, name: str, namespace: Optional[str] = None) -> TPUJob:
         return self.cluster.get_job(namespace or self.namespace, name)
 
-    def patch(self, name: str, patch_fn: Callable[[TPUJob], None],
+    def patch(self, name: str, patch: Union[dict, Callable[[TPUJob], None]],
               namespace: Optional[str] = None) -> TPUJob:
+        """Patch a job.
+
+        With a dict: JSON-merge-patch, the reference SDK's semantics
+        (tf_job_client.py:114-136) — applied server-side on backends that
+        support it (KubernetesCluster.patch_job), so concurrent patches to
+        different fields don't race the way read-modify-write does.
+        With a callable: legacy read-modify-write convenience.
+        """
+        ns = namespace or self.namespace
+        if callable(patch):
+            job = self.get(name, namespace)
+            patch(job)
+            return self.cluster.update_job(job)
+        patcher = getattr(self.cluster, "patch_job", None)
+        if patcher is not None:
+            return patcher(ns, name, patch)
+        from ..api import serialization
+
         job = self.get(name, namespace)
-        patch_fn(job)
-        return self.cluster.update_job(job)
+        merged = _json_merge_patch(serialization.job_to_dict(job), patch)
+        return self.cluster.update_job(serialization.job_from_dict(merged))
 
     def delete(self, name: str, namespace: Optional[str] = None) -> None:
         self.cluster.delete_job(namespace or self.namespace, name)
