@@ -11,8 +11,16 @@ per (batch·head, q-block); K/V for that head stay resident in VMEM and are
 walked block-by-block with `lax.fori_loop` (static trip count — no dynamic
 shapes under jit).
 
-The backward pass currently recomputes through the XLA fallback (correct,
-O(T²) memory at grad time); a Pallas backward is a planned optimization.
+Backward is a Pallas kernel pair (FlashAttention-2 style, recompute-free in
+HBM terms): the forward saves per-row logsumexp; dq walks K-blocks per
+Q-block, dk/dv walk Q-blocks per K-block, each rebuilding P from (q,k,lse)
+in VMEM so the O(T²) probability matrix never materializes at grad time.
+Off-TPU the whole op (fwd+bwd) is plain XLA.
+
+Sequence lengths that don't divide the block size are zero-padded to the
+next block boundary; padded key positions are masked with -inf inside the
+kernels and padded query rows are sliced off, so any seq_len works.
+
 Sequence-parallel long-context attention lives in parallel/ring_attention.py
 and composes with this kernel per-shard.
 """
@@ -34,8 +42,23 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
-                  block_q: int, block_k: int, seq_len: int):
+def _pad_seq(x, block: int):
+    """Zero-pad dim -2 (seq) up to a multiple of `block`."""
+    seq = x.shape[-2]
+    pad = (-seq) % block
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * (x.ndim - 2) + [(0, pad), (0, 0)]
+    return jnp.pad(x, widths)
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                causal: bool, block_q: int, block_k: int, seq_len: int,
+                real_len: int):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
     num_kb = seq_len // block_k
@@ -52,10 +75,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
+        k_pos = kb * block_k + cols
         if causal:
             q_pos = qi * block_q + rows
-            k_pos = kb * block_k + cols
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if real_len < seq_len:
+            s = jnp.where(k_pos < real_len, s, NEG_INF)  # padded keys
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
@@ -79,47 +104,245 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool,
     else:
         num_iters = num_kb
     m, l, acc = lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
-    l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # logsumexp per row; padded/empty rows get m=-inf -> store 0 (unused)
+    lse = jnp.where(l > 0.0, m + jnp.log(l_safe), 0.0)
+    lse_ref[0] = lse[:, 0]
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool,
                    block_q: int, block_k: int, interpret: bool):
-    batch, heads, seq_len, head_dim = q.shape
+    """Returns (out [B,H,T,D], lse [B*H, Tp]) — lse is on the padded grid."""
+    batch, heads, real_len, head_dim = q.shape
+    block_q = min(block_q, max(real_len, 1))
+    block_k = min(block_k, max(real_len, 1))
+    qf = _pad_seq(q.reshape(batch * heads, real_len, head_dim), block_q)
+    kf = _pad_seq(k.reshape(batch * heads, real_len, head_dim), block_k)
+    vf = _pad_seq(v.reshape(batch * heads, real_len, head_dim), block_k)
+    # one padded length for both axes so the kernel's seq_len is square
+    seq_len = max(qf.shape[1], kf.shape[1])
+    qf = _pad_seq(qf, seq_len)
+    kf = _pad_seq(kf, seq_len)
+    vf = _pad_seq(vf, seq_len)
     bh = batch * heads
-    qf = q.reshape(bh, seq_len, head_dim)
-    kf = k.reshape(bh, seq_len, head_dim)
-    vf = v.reshape(bh, seq_len, head_dim)
-
-    block_q = min(block_q, seq_len)
-    block_k = min(block_k, seq_len)
-    if seq_len % block_q or seq_len % block_k:
-        raise ValueError(f"seq_len {seq_len} must be divisible by block sizes")
 
     grid = (bh, seq_len // block_q)
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_len=seq_len,
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=seq_len, real_len=real_len,
     )
-    in_specs = [
-        pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-        pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
-        pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
-    ]
-    out_spec = pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct(qf.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_len), jnp.float32),
+        ),
         grid=grid,
-        in_specs=in_specs,
-        out_specs=out_spec,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ),
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(batch, heads, seq_len, head_dim)
+    out = out[:, :real_len, :].reshape(batch, heads, real_len, head_dim)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward (FlashAttention-2 style)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale: float, causal: bool, block_q: int, block_k: int,
+                   seq_len: int, real_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)        # [block_q, D]
+    do = do_ref[0].astype(jnp.float32)      # [block_q, D]
+    lse = lse_ref[0][:, None]               # [block_q, 1]
+    delta = delta_ref[0][:, None]           # [block_q, 1]
+    num_kb = seq_len // block_k
+
+    rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q * scale, k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        k_pos = kb * block_k + cols
+        if causal:
+            q_pos = qi * block_q + rows
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if real_len < seq_len:
+            s = jnp.where(k_pos < real_len, s, NEG_INF)
+        p = jnp.exp(s - lse)                 # [block_q, block_k]
+        dp = jax.lax.dot_general(
+            do, v_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds, k_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
+    if causal:
+        num_iters = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+        num_iters = jnp.minimum(num_iters, num_kb)
+    else:
+        num_iters = num_kb
+    dq = lax.fori_loop(0, num_iters, body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale: float, causal: bool,
+                    block_q: int, block_k: int, seq_len: int, real_len: int):
+    ki = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)     # [block_k, D]
+    v_blk = v_ref[0].astype(jnp.float32)     # [block_k, D]
+    num_qb = seq_len // block_q
+
+    rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(
+            q * scale, k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        q_pos = qb * block_q + rows
+        k_pos = ki * block_k + cols
+        if causal:
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        if real_len < seq_len:
+            # padded q rows: lse=0 would make p=exp(s) garbage; mask them
+            s = jnp.where(q_pos < real_len, s, NEG_INF)
+            s = jnp.where(k_pos < real_len, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_new = dv + jax.lax.dot_general(
+            p, do,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_k, D]
+        dp = jax.lax.dot_general(
+            do, v_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta)                # [block_q, block_k]
+        dk_new = dk + jax.lax.dot_general(
+            ds, q,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((block_k, k_ref.shape[-1]), jnp.float32)
+    dv0 = jnp.zeros((block_k, v_ref.shape[-1]), jnp.float32)
+    if causal:
+        # Q-blocks strictly before this K-block's first row contribute
+        # nothing under the causal mask; start the walk at the diagonal.
+        start = lax.div(ki * block_k, block_q)
+    else:
+        start = 0
+    dk, dv = lax.fori_loop(start, num_qb, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
+                    block_q: int, block_k: int, interpret: bool):
+    batch, heads, real_len, head_dim = q.shape
+    block_q = min(block_q, max(real_len, 1))
+    block_k = min(block_k, max(real_len, 1))
+    bh = batch * heads
+
+    def flat(x, block):
+        return _pad_seq(x.reshape(bh, real_len, head_dim), block)
+
+    qf = flat(q, block_q)
+    kf = flat(k, block_k)
+    vf = flat(v, block_k)
+    dof = flat(g, block_q)
+    seq_len = max(qf.shape[1], kf.shape[1])
+    qf, kf, vf, dof = (_pad_seq(x, seq_len) for x in (qf, kf, vf, dof))
+    # delta = rowsum(dO * O): tiny elementwise reduce, XLA fuses it
+    delta = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    ).reshape(bh, real_len)
+    pad = seq_len - real_len
+    if pad:
+        delta = jnp.pad(delta, ((0, 0), (0, pad)))
+        # lse comes from the forward on the same padded grid already
+    lse = lse[:, :seq_len] if lse.shape[1] >= seq_len else jnp.pad(
+        lse, ((0, 0), (0, seq_len - lse.shape[1]))
+    )
+
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, seq_len=seq_len, real_len=real_len)
+    qspec = pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))
+    kfull = pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0))
+    qfull = pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0))
+    rowspec_q = pl.BlockSpec((1, block_q), lambda b, i: (b, i))
+    rowfull = pl.BlockSpec((1, seq_len), lambda b, i: (b, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
+        grid=(bh, seq_len // block_q),
+        in_specs=[qspec, kfull, kfull, qspec, rowspec_q, rowspec_q],
+        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    kspec = pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        out_shape=(
+            jax.ShapeDtypeStruct(kf.shape, k.dtype),
+            jax.ShapeDtypeStruct(vf.shape, v.dtype),
+        ),
+        grid=(bh, seq_len // block_k),
+        in_specs=[qfull, kspec, kspec, qfull, rowfull, rowfull],
+        out_specs=(
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0)),
+        ),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    def unflat(x):
+        return x[:, :real_len, :].reshape(batch, heads, real_len, head_dim)
+
+    return unflat(dq), unflat(dk), unflat(dv)
+
+
+# ---------------------------------------------------------------------------
+# public op
 
 
 def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None):
-    """Plain-XLA attention (fallback + backward recompute path)."""
+    """Plain-XLA attention (fallback + reference for kernel tests)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     logits = jnp.einsum(
@@ -143,22 +366,33 @@ def _on_tpu() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=True, scale=None, block_q=128, block_k=128):
-    """Fused attention; Pallas kernel on TPU, XLA fallback elsewhere."""
+    """Fused attention; Pallas kernels (fwd + bwd) on TPU, XLA elsewhere."""
     s = scale if scale is not None else q.shape[-1] ** -0.5
     if _on_tpu():
-        return _flash_forward(q, k, v, s, causal, block_q, block_k, interpret=False)
+        out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k,
+                                interpret=False)
+        return out
     return xla_attention(q, k, v, causal=causal, scale=s)
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k)
-    return out, (q, k, v)
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    if _on_tpu():
+        out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
+                                  interpret=False)
+        return out, (q, k, v, out, lse)
+    out = xla_attention(q, k, v, causal=causal, scale=s)
+    return out, (q, k, v, None, None)
 
 
 def _bwd(causal, scale, block_q, block_k, res, g):
-    q, k, v = res
+    q, k, v, o, lse = res
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    if lse is not None:
+        return _flash_backward(q, k, v, o, lse, g, s, causal,
+                               block_q, block_k, interpret=False)
     _, vjp = jax.vjp(
-        lambda q, k, v: xla_attention(q, k, v, causal=causal, scale=scale), q, k, v
+        lambda q, k, v: xla_attention(q, k, v, causal=causal, scale=s), q, k, v
     )
     return vjp(g)
 
@@ -166,8 +400,26 @@ def _bwd(causal, scale, block_q, block_k, res, g):
 flash_attention.defvjp(_fwd, _bwd)
 
 
+# ---------------------------------------------------------------------------
+# interpret-mode entry points (CPU correctness tests for the kernels)
+
+
 def flash_attention_interpret(q, k, v, causal=True, scale=None,
                               block_q=128, block_k=128):
-    """Interpreter-mode kernel execution (CPU correctness tests)."""
+    """Interpreter-mode forward kernel execution."""
     s = scale if scale is not None else q.shape[-1] ** -0.5
-    return _flash_forward(q, k, v, s, causal, block_q, block_k, interpret=True)
+    out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k, interpret=True)
+    return out
+
+
+def flash_attention_grads_interpret(q, k, v, g, causal=True, scale=None,
+                                    block_q=128, block_k=128):
+    """Interpreter-mode fwd+bwd kernel execution: returns (out, dq, dk, dv)
+    for cotangent g — the CPU-testable path through the SAME kernel code the
+    TPU compiles."""
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
+                              interpret=True)
+    dq, dk, dv = _flash_backward(q, k, v, out, lse, g, s, causal,
+                                 block_q, block_k, interpret=True)
+    return out, dq, dk, dv
