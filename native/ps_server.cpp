@@ -180,6 +180,7 @@ class PsServer {
         continue;
       }
       std::lock_guard<std::mutex> lock(workers_mu_);
+      ReapFinishedLocked();
       conn_fds_.push_back(fd);
       workers_.emplace_back([this, fd] {
         // A throwing handler (bad_alloc on a corrupt frame, ...) must drop
@@ -190,8 +191,27 @@ class PsServer {
           ForgetConn(fd);
           ::close(fd);
         }
+        std::lock_guard<std::mutex> lock(workers_mu_);
+        finished_ids_.push_back(std::this_thread::get_id());
       });
     }
+  }
+
+  // Join Serve threads that have announced completion, so a long-lived shard
+  // handling many short connections doesn't accumulate dead std::threads.
+  // Caller holds workers_mu_; join() only blocks for the instants between a
+  // thread pushing its id and returning.
+  void ReapFinishedLocked() {
+    for (auto id : finished_ids_) {
+      for (auto it = workers_.begin(); it != workers_.end(); ++it) {
+        if (it->get_id() == id) {
+          it->join();
+          workers_.erase(it);
+          break;
+        }
+      }
+    }
+    finished_ids_.clear();
   }
 
   void ForgetConn(int fd) {
@@ -272,7 +292,9 @@ class PsServer {
       uint64_t elems;
       std::memcpy(&elems, payload.data() + off, 8);
       off += 8;
-      if (!fits(elems * sizeof(float))) break;
+      // Divide, don't multiply: elems >= 2^62 would wrap elems * 4 past the
+      // bounds check and desynchronize the parse offset.
+      if (elems > (payload.size() - off) / sizeof(float)) break;
       const float* grad = reinterpret_cast<const float*>(payload.data() + off);
       off += elems * sizeof(float);
       auto it = params_.find(name);
@@ -292,6 +314,7 @@ class PsServer {
   std::thread accept_thread_;
   std::mutex workers_mu_;
   std::vector<std::thread> workers_;
+  std::vector<std::thread::id> finished_ids_;
   std::vector<int> conn_fds_;
   std::mutex mu_;
   std::map<std::string, std::vector<float>> params_;

@@ -1,0 +1,207 @@
+"""Gang-scheduler churn fuzz: random preempt/repair/scale/delete interleavings.
+
+Scenario tests (test_gang_scheduler.py, test_slice_provider.py) cover each
+path once; this fuzz drives randomized sequences of fabric and job events and
+asserts the scheduler's core invariants after every step (the invariants from
+runtime/scheduler.py's docstring — no reference analogue, the reference
+delegates gang semantics to Volcano):
+
+  A. binding is gated on admission: a live bound pod always belongs to an
+     admitted gang (never a partially-bound never-admitted gang)
+  B. slice single-ownership: no fabric slice is held by two gangs, and slice
+     state/holder bookkeeping is consistent
+  C. chips conserved: the pool's used count equals the sum of admitted
+     gangs' reservations (nothing leaks across admit/release cycles)
+  D. slot-map sanity: every recorded slot references a slice actually held
+     by that gang, with no host-rank double-booking
+"""
+import random
+
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.types import ReplicaType, RestartPolicy, TPUTopology
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.runtime.cluster import InMemoryCluster, NotFound
+from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+from tf_operator_tpu.runtime.scheduler import GangScheduler
+from tf_operator_tpu.runtime.slices import FakeSliceProvider, SliceState
+
+from testutil import new_tpujob
+
+ACCEL, TOPO = "v5litepod-32", "4x8"
+HOSTS = 8  # 4x8 = 32 chips over 8 hosts
+
+
+def sliced_job(name, workers):
+    job = new_tpujob(worker=workers, name=name,
+                     restart_policy=RestartPolicy.EXIT_CODE)
+    job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+        accelerator=ACCEL, topology=TOPO
+    )
+    set_defaults(job)
+    return job
+
+
+class FuzzHarness:
+    def __init__(self, seed: int, slices: int = 3):
+        self.rng = random.Random(seed)
+        self.cluster = InMemoryCluster()
+        self.controller = TPUJobController(
+            self.cluster, config=ReconcilerConfig(enable_gang_scheduling=True)
+        )
+        self.provider = FakeSliceProvider({(ACCEL, TOPO): slices})
+        self.scheduler = GangScheduler(
+            self.cluster, slice_provider=self.provider
+        )
+        self.jobs = {}  # name -> workers
+        self.counter = 0
+
+    # -- operations ---------------------------------------------------
+
+    def op_create(self):
+        if len(self.jobs) >= 4:
+            return
+        self.counter += 1
+        name = f"fz-{self.counter}"
+        workers = self.rng.choice([HOSTS, 2 * HOSTS])
+        self.cluster.create_job(sliced_job(name, workers))
+        self.jobs[name] = workers
+
+    def op_delete(self):
+        if not self.jobs:
+            return
+        name = self.rng.choice(sorted(self.jobs))
+        try:
+            self.cluster.delete_job("default", name)
+        except NotFound:
+            pass
+        del self.jobs[name]
+
+    def op_preempt(self):
+        held = [s for s in self.provider.list_slices()
+                if s.state == SliceState.ALLOCATED]
+        if held:
+            self.provider.inject_preemption(self.rng.choice(held).id)
+
+    def op_repair(self):
+        broken = [s for s in self.provider.list_slices()
+                  if s.state == SliceState.PREEMPTED]
+        if broken:
+            self.provider.repair(self.rng.choice(broken).id)
+
+    def op_scale(self):
+        if not self.jobs:
+            return
+        name = self.rng.choice(sorted(self.jobs))
+        new_workers = self.rng.choice([HOSTS, 2 * HOSTS])
+        try:
+            job = self.cluster.get_job("default", name)
+        except NotFound:
+            return
+        job.spec.replica_specs[ReplicaType.WORKER].replicas = new_workers
+        self.cluster.update_job(job)
+        self.jobs[name] = new_workers
+
+    def op_sync(self):
+        for name in sorted(self.jobs):
+            try:
+                self.controller.sync_job(f"default/{name}")
+            except NotFound:
+                pass
+
+    def step(self):
+        op = self.rng.choice([
+            self.op_create, self.op_delete, self.op_preempt,
+            self.op_repair, self.op_scale, self.op_sync, self.op_sync,
+        ])
+        op()
+        self.op_sync()
+
+    # -- invariants ---------------------------------------------------
+
+    def check(self, step_no: int):
+        ctx = f"step {step_no}"
+        with self.scheduler._lock:
+            admitted = dict(self.scheduler._admitted)
+            slots = {k: dict(v) for k, v in self.scheduler._slots.items()}
+
+        # A: live bound pod => its gang is admitted
+        for pod in self.cluster.list_pods():
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            is_bound = (
+                pod.metadata.annotations.get("tpu-operator.dev/bound") == "true"
+            )
+            group = pod.metadata.annotations.get(constants.GANG_GROUP_ANNOTATION)
+            key = f"default/{group}" if group else None
+            if is_bound:
+                assert key in admitted, (
+                    f"{ctx}: bound pod {pod.metadata.name} of non-admitted "
+                    f"gang {key}"
+                )
+
+        # B: slice single-ownership + state/holder consistency
+        holder_of = {}
+        for slc in self.provider.list_slices():
+            if slc.holder is not None:
+                assert slc.state in (SliceState.ALLOCATED, SliceState.PREEMPTED), (
+                    f"{ctx}: slice {slc.id} held by {slc.holder} in state "
+                    f"{slc.state}"
+                )
+                assert slc.id not in holder_of, f"{ctx}: slice {slc.id} double-listed"
+                holder_of[slc.id] = slc.holder
+            else:
+                assert slc.state != SliceState.ALLOCATED, (
+                    f"{ctx}: ALLOCATED slice {slc.id} without holder"
+                )
+
+        # C: pool accounting matches the admitted set exactly
+        assert self.scheduler.pool.used == sum(admitted.values()), (
+            f"{ctx}: pool.used={self.scheduler.pool.used} != admitted sum"
+        )
+
+        # D: every slot references a slice held by that gang; no host
+        # double-booking within a slice
+        for key, slot_map in slots.items():
+            seen = set()
+            for pod_name, (_ns, slice_id, host) in slot_map.items():
+                assert holder_of.get(slice_id) == key, (
+                    f"{ctx}: slot of {pod_name} references slice {slice_id} "
+                    f"held by {holder_of.get(slice_id)}, not {key}"
+                )
+                assert (slice_id, host) not in seen, (
+                    f"{ctx}: host {host} of slice {slice_id} double-booked"
+                )
+                seen.add((slice_id, host))
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_gang_churn_fuzz(seed):
+    harness = FuzzHarness(seed)
+    for step_no in range(100):
+        harness.step()
+        harness.check(step_no)
+    # drain: delete everything, fabric must return to fully free (pods are
+    # deleted explicitly — the k8s garbage collector's owner-ref cascade,
+    # which the bare InMemoryCluster doesn't run on its own)
+    for name in list(harness.jobs):
+        try:
+            harness.cluster.delete_job("default", name)
+        except NotFound:
+            pass
+        del harness.jobs[name]
+    for pod in harness.cluster.list_pods():
+        try:
+            harness.cluster.delete_pod(pod.metadata.namespace, pod.metadata.name)
+        except NotFound:
+            pass
+    for slc in harness.provider.list_slices():
+        if slc.state == SliceState.PREEMPTED:
+            harness.provider.repair(slc.id)
+    assert all(s.holder is None for s in harness.provider.list_slices()), (
+        "slices still held after every gang departed"
+    )
+    assert harness.scheduler.pool.used == 0

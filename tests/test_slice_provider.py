@@ -156,6 +156,20 @@ def test_multislice_assignment_and_env():
     )
 
 
+def test_multislice_env_skips_non_jax_types():
+    """A PS/Evaluator group with a topology and replicas > hosts must NOT get
+    its own MEGASCALE document (coordinator would point at ps-0 and conflict
+    with the worker group's DCN view on CPU-side pods)."""
+    job = new_tpujob(worker=2, ps=4, name="slice-ps")
+    job.spec.replica_specs[ReplicaType.PS].tpu = TPUTopology(
+        accelerator="v5litepod-8", topology="2x4"
+    )
+    set_defaults(job)
+    env = gen_tpu_env(job, ReplicaType.PS, 3)
+    assert constants.ENV_MEGASCALE_NUM_SLICES not in env
+    assert constants.ENV_MEGASCALE_COORDINATOR not in env
+
+
 def test_second_gang_waits_for_slice():
     cluster, controller, provider, _ = make_stack({("v5litepod-32", "4x8"): 1})
     job_a = sliced_job("sl-a", workers=8)

@@ -194,6 +194,11 @@ def _add_multislice_env(
 
     if not rspec.tpu.topology:
         return
+    if rtype not in _JAX_PROCESS_TYPES:
+        # A PS/Evaluator group is not part of the jax.distributed process
+        # group; giving it its own MEGASCALE document (coordinator=ps-0)
+        # would hand CPU-side pods a conflicting multislice view.
+        return
     sliced_jax_types = [
         rt for rt in _JAX_PROCESS_TYPES
         if job.spec.replica_specs.get(rt) is not None

@@ -420,6 +420,20 @@ class GangScheduler:
     def _on_slice_event(self, slc: Slice, event: str) -> None:
         """Fabric notifications: whole-slice preemption and repair."""
         if event == "repaired":
+            # A repaired slice is fresh capacity with no holder; any slot
+            # entry still referencing it belongs to a gang whose claim died
+            # at preemption (its pods on the slice were failed then).
+            # Purge eagerly — left in place the stale entries pollute the
+            # gang's host-rank accounting if it ever re-allocates the same
+            # slice, and they misrepresent state between events.
+            with self._lock:
+                for slot_map in self._slots.values():
+                    stale = [
+                        name for name, (_ns, sid, _rank) in slot_map.items()
+                        if sid == slc.id
+                    ]
+                    for name in stale:
+                        del slot_map[name]
             self._retry_waiting()
             return
         if event != "preempted" or slc.holder is None:
@@ -464,7 +478,7 @@ class GangScheduler:
                 for n in names
             ]
             try:
-                self.cluster.update_pod(pod)
+                self.cluster.update_pod_status(pod)
             except NotFound:
                 continue
 

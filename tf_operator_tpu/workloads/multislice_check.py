@@ -1,0 +1,108 @@
+"""Multislice (MEGASCALE/DCN) topology verification workload.
+
+The controller's topology injector emits a MEGASCALE document for worker
+groups spanning several TPU slices (controller/topology.py:_add_multislice_env
+— SURVEY.md §7's "across slices/DCN, emit coordinator addresses").  On real
+hardware libtpu consumes it during jax.distributed init; this workload is the
+hermetic behavioral check (the analogue of the reference proving TF_CONFIG by
+instantiating RunConfig in-container, test_app.py:35-44): every replica
+
+  1. forms the REAL cross-process group via jax.distributed.initialize from
+     the injected coordinator env,
+  2. allgathers its (process_id, slice_id) over that live group, and
+  3. verifies the assembled fabric view — slice count, per-slice membership
+     (index//hosts packing, contiguous host ranks), document agreement
+     across processes, and that the DCN coordinator is slice 0's host 0
+     (cross-checked against the TF_CONFIG worker[0] address, not a string
+     the test hard-codes)
+
+so a wrong slice-id layout or coordinator choice fails by behavior on every
+process, not by env-var string-matching in the test.
+
+Exit 0 iff every check passes on every process.
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    from ..api import constants
+    from ..runtime.slices import topology_hosts
+    from .runner import WorkloadContext, apply_forced_platform
+
+    apply_forced_platform()
+    ctx = WorkloadContext.from_env()
+
+    num_slices = int(os.environ.get(constants.ENV_MEGASCALE_NUM_SLICES, "1"))
+    slice_id = int(os.environ.get(constants.ENV_MEGASCALE_SLICE_ID, "0"))
+    dcn_coord = os.environ.get(constants.ENV_MEGASCALE_COORDINATOR, "")
+    print(
+        f"multislice_check: index={ctx.replica_index} pid={ctx.process_id} "
+        f"slice={slice_id}/{num_slices} dcn_coord={dcn_coord}",
+        flush=True,
+    )
+    if num_slices < 2:
+        print("single slice; no DCN document expected", flush=True)
+        return 0 if not dcn_coord else 1
+
+    hosts = topology_hosts(ctx.slice_topology)
+
+    # 1. the global group must actually form over the injected coordinator
+    import jax
+    import numpy as np
+
+    ctx.initialize_distributed()
+    from jax.experimental import multihost_utils
+
+    # 2. carry (process_id, slice_id) over the live collective
+    table = multihost_utils.process_allgather(
+        np.array([ctx.process_id, slice_id], dtype=np.int32)
+    )  # [num_processes, 2]
+    print(f"fabric table: {table.tolist()}", flush=True)
+
+    # 3a. the fabric has exactly the advertised number of slices
+    seen_slices = sorted(set(int(r[1]) for r in table))
+    if seen_slices != list(range(num_slices)):
+        print(f"FAIL: slices seen {seen_slices} != 0..{num_slices - 1}",
+              flush=True)
+        return 1
+    # 3b. slice membership is the scheduler's packing: slice = index // hosts,
+    # each slice fully populated
+    for pid, sid in ((int(r[0]), int(r[1])) for r in table):
+        if pid // hosts != sid:
+            print(f"FAIL: process {pid} claims slice {sid}, packing says "
+                  f"{pid // hosts}", flush=True)
+            return 1
+    counts = {s: sum(1 for r in table if int(r[1]) == s) for s in seen_slices}
+    if any(c != hosts for c in counts.values()):
+        print(f"FAIL: per-slice host counts {counts} != {hosts}", flush=True)
+        return 1
+    # 3c. every process got the SAME dcn coordinator document
+    coords = multihost_utils.process_allgather(
+        np.frombuffer(dcn_coord.ljust(64)[:64].encode(), dtype=np.uint8)
+    )
+    if not all(bytes(c.tobytes()) == coords[0].tobytes() for c in coords):
+        print("FAIL: processes disagree on the DCN coordinator", flush=True)
+        return 1
+    # 3d. the DCN coordinator is slice 0 host 0 — cross-checked against the
+    # independently-injected TF_CONFIG cluster map (worker[0]'s address),
+    # which the substrate resolved, not the test
+    if ctx.tf_config:
+        worker0 = ctx.tf_config["cluster"]["worker"][0]
+        host0 = worker0.rsplit(":", 1)[0]
+        dcn_host = dcn_coord.rsplit(":", 1)[0]
+        if dcn_host != host0:
+            print(f"FAIL: DCN coordinator host {dcn_host} is not worker-0 "
+                  f"host {host0}", flush=True)
+            return 1
+        if ctx.process_id == 0 and slice_id != 0:
+            print("FAIL: process 0 is not on slice 0", flush=True)
+            return 1
+    print("multislice_check OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
