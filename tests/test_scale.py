@@ -1,0 +1,92 @@
+"""Control-plane scale soak: O(100) concurrent jobs, the reference's stated
+design envelope ("scaling is not a problem" at O(100) TFJobs per cluster,
+tf_job_design_doc.md:24-27).
+
+Asserts the three properties that break first under load:
+  - every job converges (all pods + services exist for every job)
+  - no duplicate pod creations, even transiently (the expectations cache's
+    whole job is preventing re-creates from stale views — expectation.go:13-25)
+  - the workqueue drains (no livelock/requeue storm)
+and records the observed submit->converged wall time so the number lands in
+test output.
+"""
+import threading
+import time
+
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.runtime.cluster import InMemoryCluster
+
+from testutil import new_tpujob
+
+N_JOBS = 100
+WORKERS_PER_JOB = 2
+
+
+@pytest.mark.slow
+def test_hundred_concurrent_jobs_converge_without_duplicates():
+    cluster = InMemoryCluster()
+
+    create_calls = []
+    orig_create = cluster.create_pod
+
+    def counting_create(pod):
+        create_calls.append(pod.metadata.name)
+        return orig_create(pod)
+
+    cluster.create_pod = counting_create
+
+    controller = TPUJobController(cluster, threadiness=4)
+    controller.start()
+    try:
+        t0 = time.perf_counter()
+        for i in range(N_JOBS):
+            cluster.create_job(new_tpujob(worker=WORKERS_PER_JOB,
+                                          name=f"scale-{i:03d}"))
+        deadline = time.time() + 120
+        expected_pods = N_JOBS * WORKERS_PER_JOB
+        while time.time() < deadline:
+            if len(cluster.list_pods()) == expected_pods:
+                break
+            time.sleep(0.05)
+        converged = time.perf_counter() - t0
+        pods = cluster.list_pods()
+        assert len(pods) == expected_pods, (
+            f"only {len(pods)}/{expected_pods} pods after 120s"
+        )
+        services = cluster.list_services()
+        assert len(services) == expected_pods
+
+        # exactly one create per (job, index) — no duplicates even transiently
+        assert len(create_calls) == len(set(create_calls)) == expected_pods, (
+            f"{len(create_calls)} creates for {expected_pods} pods"
+        )
+
+        # every job got its exact replica set
+        for i in range(N_JOBS):
+            name = f"scale-{i:03d}"
+            job_pods = sorted(
+                p.metadata.name
+                for p in cluster.list_pods(selector={constants.LABEL_JOB_NAME: name})
+            )
+            assert job_pods == [f"{name}-worker-{j}"
+                                for j in range(WORKERS_PER_JOB)]
+
+        # queue drains: no requeue storm keeps the workers hot forever
+        drain_deadline = time.time() + 30
+        while time.time() < drain_deadline:
+            if len(controller.work_queue) == 0:
+                break
+            time.sleep(0.05)
+        assert len(controller.work_queue) == 0, "workqueue never drained"
+
+        print(f"\n{N_JOBS} jobs -> {expected_pods} pods converged in "
+              f"{converged:.2f}s ({expected_pods / converged:.0f} pods/s)")
+        # generous bound: the reference's library notes ~10 pods/s as the
+        # conservative expectation (expectation.go:13-25); we assert we are
+        # not an order of magnitude slower than that.
+        assert converged < 60
+    finally:
+        controller.stop()

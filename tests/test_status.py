@@ -93,6 +93,53 @@ class TestWorkerRules:
         assert not conditions.is_succeeded(job.status)
         assert conditions.is_running(job.status)
 
+    def test_all_workers_policy_with_evaluator_present(self):
+        """AllWorkers success + Evaluator: all workers done -> Succeeded even
+        while the evaluator is still running (the evaluator never gates
+        success — ref status.go evaluates it for Running/Failed only)."""
+        controller, cluster, *_ = new_controller()
+        job = new_tpujob(worker=3, evaluator=1)
+        job.spec.success_policy = SuccessPolicy.ALL_WORKERS
+        set_pods(cluster, job, ReplicaType.WORKER, succeeded=3)
+        set_pods(cluster, job, ReplicaType.EVALUATOR, active=1)
+        job = sync(controller, cluster, job)
+        assert conditions.is_succeeded(job.status)
+        assert job.status.completion_time is not None
+
+    def test_restart_then_succeed_ordering(self):
+        """Restarting -> Succeeded across syncs: after an ExitCode restart
+        cycle, a later all-workers success must land Succeeded as the latest
+        condition (ref status matrix: restart does not wedge the job)."""
+        from tf_operator_tpu.api.types import RestartPolicy
+
+        from tf_operator_tpu.runtime.control import RealPodControl, RealServiceControl
+
+        controller, cluster, *_ = new_controller()
+        controller.reconciler.pod_control = RealPodControl(cluster)
+        controller.reconciler.service_control = RealServiceControl(cluster)
+        job = new_tpujob(worker=1, restart_policy=RestartPolicy.EXIT_CODE)
+        cluster.create_job(job)
+        controller.sync_job(job.key())  # creates worker-0
+        # the sole worker dies with a retryable code -> restart cycle
+        # (a Running sibling would replace Restarting with Running — that
+        # path is covered by test_retryable_code_with_running_sibling)
+        cluster.set_pod_phase("default", "test-tpujob-worker-0",
+                              PodPhase.FAILED, exit_code=143)
+        controller.sync_job(job.key())  # deletes the pod, sets Restarting
+        stored = cluster.get_job(job.metadata.namespace, job.metadata.name)
+        assert conditions.has_condition(stored.status, JobConditionType.RESTARTING)
+        controller.sync_job(job.key())  # recreates worker-0
+        cluster.set_pod_phase("default", "test-tpujob-worker-0",
+                              PodPhase.SUCCEEDED, exit_code=0)
+        controller.sync_job(job.key())
+        final = cluster.get_job(job.metadata.namespace, job.metadata.name)
+        assert conditions.is_succeeded(final.status)
+        assert not conditions.is_failed(final.status)
+        # ordering: the newest true condition is Succeeded, so SDK
+        # get_job_status (latest-true-wins) reports Succeeded
+        latest = [c for c in final.status.conditions if c.status][-1]
+        assert latest.type == JobConditionType.SUCCEEDED
+
     def test_workers_running(self):
         controller, cluster, *_ = new_controller()
         job = new_tpujob(worker=2)
