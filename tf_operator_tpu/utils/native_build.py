@@ -48,7 +48,9 @@ def load_native_lib(src: str, lib: str, timeout: float = 120.0) -> Optional[ctyp
     try:
         return ctypes.CDLL(lib)
     except OSError:
-        if os.path.exists(src) and _build(src, lib, timeout):
+        # Only retry when we did NOT just build: a freshly-built-but-
+        # unloadable artifact would fail identically a second time.
+        if not stale and os.path.exists(src) and _build(src, lib, timeout):
             try:
                 return ctypes.CDLL(lib)
             except OSError:
