@@ -17,6 +17,18 @@ Q-block, dk/dv walk Q-blocks per K-block, each rebuilding P from (q,k,lse)
 in VMEM so the O(T²) probability matrix never materializes at grad time.
 Off-TPU the whole op (fwd+bwd) is plain XLA.
 
+Per-row scalars (lse, delta) cross the kernel boundary **lane-replicated**
+as [batch·heads, seq, 128] tiles: Mosaic requires the last two dims of
+every block to be (multiple-of-8, multiple-of-128) or equal to the array
+dims, so a [rows] vector per q-block is stored as a (block_q, 128) tile
+with the value repeated across lanes — the same layout jax's reference TPU
+flash kernel uses for its l/m outputs. A (1, block_q) row-block violates
+the tiling constraint and fails Mosaic lowering (round-2 VERDICT finding;
+repro log in artifacts/flash_repro_r03_before.log). Between fwd and bwd the
+lse residual is carried compact at [bh, Tp] (lane 0 sliced off right after
+the forward pallas_call) and re-broadcast at the backward's boundary, so
+the replication never inflates saved-activation HBM.
+
 Sequence lengths that don't divide the block size are zero-padded to the
 next block boundary; padded key positions are masked with -inf inside the
 kernels and padded query rows are sliced off, so any seq_len works.
@@ -40,6 +52,22 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 NEG_INF = -1e30
+LANE = 128  # TPU vector lane width; minor dim of every row-scalar tile
+
+
+def _cols(x, width: int):
+    """Expand a lane-replicated [rows, LANE] tile to [rows, width].
+
+    Every lane holds the same per-row scalar, so slicing or tiling along
+    lanes preserves the value while matching the score block's k-width.
+    """
+    lanes = x.shape[-1]
+    if width == lanes:
+        return x
+    if width < lanes:
+        return x[:, :width]
+    reps = (width + lanes - 1) // lanes
+    return jnp.tile(x, (1, reps))[:, :width]
 
 
 def _pad_seq(x, block: int):
@@ -56,7 +84,7 @@ def _pad_seq(x, block: int):
 # forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, scale: float,
                 causal: bool, block_q: int, block_k: int, seq_len: int,
                 real_len: int):
     qi = pl.program_id(1)
@@ -106,14 +134,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     m, l, acc = lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    # logsumexp per row; padded/empty rows get m=-inf -> store 0 (unused)
-    lse = jnp.where(l > 0.0, m + jnp.log(l_safe), 0.0)
-    lse_ref[0] = lse[:, 0]
+    if maybe_lse_ref:  # omitted entirely on the primal-only path
+        # logsumexp per row; padded/empty rows get m=-inf -> store 0 (unused)
+        lse = jnp.where(l > 0.0, m + jnp.log(l_safe), 0.0)  # [block_q, 1]
+        maybe_lse_ref[0][0] = jnp.broadcast_to(lse, (lse.shape[0], LANE))
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool,
-                   block_q: int, block_k: int, interpret: bool):
-    """Returns (out [B,H,T,D], lse [B*H, Tp]) — lse is on the padded grid."""
+                   block_q: int, block_k: int, interpret: bool,
+                   save_lse: bool = True):
+    """Returns (out [B,H,T,D], lse [B*H, Tp] or None) — lse on the padded
+    grid, compacted to one lane outside the kernel (the kernel emits the
+    Mosaic-legal lane-replicated tile; carrying the residual at [bh, Tp]
+    keeps fwd→bwd HBM at 1/LANE of the tile form). With save_lse=False the
+    lse output is omitted entirely (primal-only path writes nothing)."""
     batch, heads, real_len, head_dim = q.shape
     block_q = min(block_q, max(real_len, 1))
     block_k = min(block_k, max(real_len, 1))
@@ -132,24 +166,25 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, seq_len=seq_len, real_len=real_len,
     )
-    out, lse = pl.pallas_call(
+    out_shape = [jax.ShapeDtypeStruct(qf.shape, q.dtype)]
+    out_specs = [pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))]
+    if save_lse:
+        out_shape.append(jax.ShapeDtypeStruct((bh, seq_len, LANE), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, block_q, LANE), lambda b, i: (b, i, 0)))
+    res = pl.pallas_call(
         kernel,
-        out_shape=(
-            jax.ShapeDtypeStruct(qf.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_len), jnp.float32),
-        ),
+        out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=(
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-        ),
+        out_specs=tuple(out_specs),
         interpret=interpret,
     )(qf, kf, vf)
+    out = res[0]
+    lse = res[1][:, :, 0] if save_lse else None
     out = out[:, :real_len, :].reshape(batch, heads, real_len, head_dim)
     return out, lse
 
@@ -164,8 +199,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)        # [block_q, D]
     do = do_ref[0].astype(jnp.float32)      # [block_q, D]
-    lse = lse_ref[0][:, None]               # [block_q, 1]
-    delta = delta_ref[0][:, None]           # [block_q, 1]
+    lse = _cols(lse_ref[0], block_k)        # [block_q, block_k] replicated
+    delta = _cols(delta_ref[0], block_k)    # [block_q, block_k] replicated
     num_kb = seq_len // block_k
 
     rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -223,8 +258,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        lse = _cols(lse_ref[0, pl.ds(qb * block_q, block_q), :], block_k)
+        delta = _cols(delta_ref[0, pl.ds(qb * block_q, block_q), :], block_k)
         s = jax.lax.dot_general(
             q * scale, k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
@@ -286,7 +321,10 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     dof = flat(g, block_q)
     seq_len = max(qf.shape[1], kf.shape[1])
     qf, kf, vf, dof = (_pad_seq(x, seq_len) for x in (qf, kf, vf, dof))
-    # delta = rowsum(dO * O): tiny elementwise reduce, XLA fuses it
+    # delta = rowsum(dO * O): tiny elementwise reduce. Both per-row scalars
+    # (delta, lse [bh, Tp]) are lane-replicated to the [bh, Tp, LANE] tile
+    # layout the kernels read (module docstring) only here, at the kernel
+    # boundary, so the fwd→bwd residual stays compact.
     delta = jnp.sum(
         g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     ).reshape(bh, real_len)
@@ -297,14 +335,16 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     lse = lse[:, :seq_len] if lse.shape[1] >= seq_len else jnp.pad(
         lse, ((0, 0), (0, seq_len - lse.shape[1]))
     )
+    delta = jnp.broadcast_to(delta[:, :, None], (bh, seq_len, LANE))
+    lse = jnp.broadcast_to(lse[:, :, None], (bh, seq_len, LANE))
 
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, seq_len=seq_len, real_len=real_len)
     qspec = pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))
     kfull = pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0))
     qfull = pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0))
-    rowspec_q = pl.BlockSpec((1, block_q), lambda b, i: (b, i))
-    rowfull = pl.BlockSpec((1, seq_len), lambda b, i: (b, 0))
+    rowspec_q = pl.BlockSpec((1, block_q, LANE), lambda b, i: (b, i, 0))
+    rowfull = pl.BlockSpec((1, seq_len, LANE), lambda b, i: (b, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
@@ -370,7 +410,7 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=128, block_k=128):
     s = scale if scale is not None else q.shape[-1] ** -0.5
     if _on_tpu():
         out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k,
-                                interpret=False)
+                                interpret=False, save_lse=False)
         return out
     return xla_attention(q, k, v, causal=causal, scale=s)
 
@@ -406,9 +446,11 @@ flash_attention.defvjp(_fwd, _bwd)
 
 def flash_attention_interpret(q, k, v, causal=True, scale=None,
                               block_q=128, block_k=128):
-    """Interpreter-mode forward kernel execution."""
+    """Interpreter-mode forward kernel execution (the same primal-only
+    no-lse variant the TPU compiles)."""
     s = scale if scale is not None else q.shape[-1] ** -0.5
-    out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k, interpret=True)
+    out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k,
+                            interpret=True, save_lse=False)
     return out
 
 
