@@ -6,16 +6,23 @@ TPU kernel: blockwise online-softmax attention (Flash-style) that keeps the
 O(T²) score matrix out of HBM, tiled to the MXU (128-aligned blocks, bf16
 inputs, f32 accumulation).
 
-Layout: q/k/v are [batch, heads, seq, head_dim]. The grid maps one program
-per (batch·head, q-block); K/V for that head stay resident in VMEM and are
-walked block-by-block with `lax.fori_loop` (static trip count — no dynamic
-shapes under jit).
+Layout: q/k/v are [batch, heads, seq, head_dim]. Each kernel runs on a 3-D
+grid — (batch·head, q-block, k-block) for the forward and dq, (batch·head,
+k-block, q-block) for dk/dv — with the reduction dimension innermost and
+"arbitrary" semantics: running state (online-softmax m/l/acc, grad
+accumulators) lives in f32 VMEM scratch that persists across the innermost
+grid steps, is initialised when the reduction index is 0 and written out on
+its last step. Only one (block, head_dim) tile of each operand is resident
+per step, so VMEM use is independent of sequence length and the DMA
+pipeline overlaps the next block's fetch with the current block's matmuls
+(the same structure as jax's stock TPU flash kernel). Causally-dead blocks
+skip their FLOPs via pl.when but still advance the pipeline.
 
 Backward is a Pallas kernel pair (FlashAttention-2 style, recompute-free in
-HBM terms): the forward saves per-row logsumexp; dq walks K-blocks per
-Q-block, dk/dv walk Q-blocks per K-block, each rebuilding P from (q,k,lse)
-in VMEM so the O(T²) probability matrix never materializes at grad time.
-Off-TPU the whole op (fwd+bwd) is plain XLA.
+HBM terms): the forward saves per-row logsumexp; dq accumulates over
+K-blocks per Q-block, dk/dv accumulate over Q-blocks per K-block, each
+rebuilding P from (q,k,lse) in VMEM so the O(T²) probability matrix never
+materializes at grad time. Off-TPU the whole op (fwd+bwd) is plain XLA.
 
 Per-row scalars (lse, delta) cross the kernel boundary **lane-replicated**
 as [batch·heads, seq, 128] tiles: Mosaic requires the last two dims of
@@ -46,10 +53,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
-try:  # TPU backend only
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
+# Importable on any platform (CPU interpret mode included); only kernel
+# *compilation* needs TPU hardware.
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 LANE = 128  # TPU vector lane width; minor dim of every row-scalar tile
@@ -59,7 +65,7 @@ def _cols(x, width: int):
     """Expand a lane-replicated [rows, LANE] tile to [rows, width].
 
     Every lane holds the same per-row scalar, so slicing or tiling along
-    lanes preserves the value while matching the score block's k-width.
+    lanes preserves the value while matching the target tile's width.
     """
     lanes = x.shape[-1]
     if width == lanes:
@@ -68,6 +74,12 @@ def _cols(x, width: int):
         return x[:, :width]
     reps = (width + lanes - 1) // lanes
     return jnp.tile(x, (1, reps))[:, :width]
+
+
+def _causal_live(qi, ki, block_q: int, block_k: int):
+    """Whether block (qi, ki) has any unmasked position under the causal
+    mask: its last q row sees at least the first k column."""
+    return (qi + 1) * block_q - 1 >= ki * block_k
 
 
 def _pad_seq(x, block: int):
@@ -80,64 +92,88 @@ def _pad_seq(x, block: int):
     return jnp.pad(x, widths)
 
 
+def _compiler_params(interpret: bool, semantics):
+    """dimension_semantics hint (parallel/arbitrary per grid dim); ignored
+    in interpret mode and absent off-TPU."""
+    if interpret:
+        return {}
+    return {
+        "compiler_params": pltpu.CompilerParams(dimension_semantics=semantics)
+    }
+
+
 # ---------------------------------------------------------------------------
 # forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *maybe_lse_ref, scale: float,
-                causal: bool, block_q: int, block_k: int, seq_len: int,
-                real_len: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
+                causal: bool, block_q: int, block_k: int, num_kb: int,
+                real_len: int, seq_len: int):
+    # rest = optional lse output ref, then the 3 VMEM scratch refs
+    # (pallas passes refs positionally: inputs, outputs, scratch)
+    maybe_lse_ref, (m_scr, l_scr, acc_scr) = rest[:-3], rest[-3:]
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
-    num_kb = seq_len // block_k
+    ki = pl.program_id(2)
+    head_dim = q_ref.shape[-1]
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
     rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+        k_blk = k_ref[0].astype(jnp.float32)      # [block_k, D]
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
-            q, k_blk.astype(jnp.float32),
+            q, k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
-        k_pos = kb * block_k + cols
+        k_pos = ki * block_k + cols
         if causal:
             q_pos = qi * block_q + rows
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         if real_len < seq_len:
             s = jnp.where(k_pos < real_len, s, NEG_INF)  # padded keys
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, v_blk.astype(jnp.float32),
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+        m_prev = m_scr[...]                       # [block_q, LANE] replicated
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)           # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(m_cur, m_prev.shape))
+        alpha = jnp.exp(m_prev - m_new)                      # replicated
+        p = jnp.exp(s - _cols(m_new, block_k))
+        l_new = alpha * l_prev + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), l_prev.shape
         )
-        return m_new, l_new, acc_new
+        acc_scr[...] = acc_scr[...] * _cols(alpha, head_dim) + (
+            jax.lax.dot_general(
+                p, v_blk,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        )
+        m_scr[...] = m_new
+        l_scr[...] = l_new
 
-    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
     if causal:
-        # Blocks strictly above the diagonal contribute nothing; bound the
-        # walk at the q-block's last row (static grid, traced bound is fine
-        # for fori_loop).
-        num_iters = lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        num_iters = jnp.minimum(num_iters, num_kb)
+        # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
+        pl.when(_causal_live(qi, ki, block_q, block_k))(_compute)
     else:
-        num_iters = num_kb
-    m, l, acc = lax.fori_loop(0, num_iters, body, (m0, l0, acc0))
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    if maybe_lse_ref:  # omitted entirely on the primal-only path
-        # logsumexp per row; padded/empty rows get m=-inf -> store 0 (unused)
-        lse = jnp.where(l > 0.0, m + jnp.log(l_safe), 0.0)  # [block_q, 1]
-        maybe_lse_ref[0][0] = jnp.broadcast_to(lse, (lse.shape[0], LANE))
+        _compute()
+
+    @pl.when(ki == num_kb - 1)
+    def _write():
+        m = m_scr[...]
+        l = l_scr[...]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / _cols(l_safe, head_dim)).astype(o_ref.dtype)
+        if maybe_lse_ref:  # omitted entirely on the primal-only path
+            # padded/empty rows keep m=-inf -> store 0 (unused downstream)
+            maybe_lse_ref[0][0] = jnp.where(l > 0.0, m + jnp.log(l_safe), 0.0)
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool,
@@ -160,28 +196,40 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
     kf = _pad_seq(kf, seq_len)
     vf = _pad_seq(vf, seq_len)
     bh = batch * heads
+    num_kb = seq_len // block_k
 
-    grid = (bh, seq_len // block_q)
+    grid = (bh, seq_len // block_q, num_kb)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, seq_len=seq_len, real_len=real_len,
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, num_kb=num_kb, real_len=real_len, seq_len=seq_len,
     )
     out_shape = [jax.ShapeDtypeStruct(qf.shape, q.dtype)]
-    out_specs = [pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))]
+    out_specs = [
+        pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0))
+    ]
     if save_lse:
         out_shape.append(jax.ShapeDtypeStruct((bh, seq_len, LANE), jnp.float32))
-        out_specs.append(pl.BlockSpec((1, block_q, LANE), lambda b, i: (b, i, 0)))
+        out_specs.append(
+            pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, i, 0))
+        )
+    scratch = [
+        pltpu.VMEM((block_q, LANE), jnp.float32),       # m
+        pltpu.VMEM((block_q, LANE), jnp.float32),       # l
+        pltpu.VMEM((block_q, head_dim), jnp.float32),   # acc
+    ]
     res = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=tuple(out_specs),
+        scratch_shapes=scratch,
         interpret=interpret,
+        **_compiler_params(interpret, ("parallel", "parallel", "arbitrary")),
     )(qf, kf, vf)
     out = res[0]
     lse = res[1][:, :, 0] if save_lse else None
@@ -193,28 +241,32 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
 # backward (FlashAttention-2 style)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *,
                    scale: float, causal: bool, block_q: int, block_k: int,
-                   seq_len: int, real_len: int):
+                   num_kb: int, real_len: int, seq_len: int):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)        # [block_q, D]
-    do = do_ref[0].astype(jnp.float32)      # [block_q, D]
-    lse = _cols(lse_ref[0], block_k)        # [block_q, block_k] replicated
-    delta = _cols(delta_ref[0], block_k)    # [block_q, block_k] replicated
-    num_kb = seq_len // block_k
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
 
     rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-
-    def body(kb, dq):
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = _cols(lse_ref[0], block_k)     # [block_q, block_k] replicated
+        delta = _cols(delta_ref[0], block_k)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q * scale, k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        k_pos = kb * block_k + cols
+        k_pos = ki * block_k + cols
         if causal:
             q_pos = qi * block_q + rows
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
@@ -227,45 +279,50 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta)
-        return dq + jax.lax.dot_general(
+        dq_scr[...] = dq_scr[...] + jax.lax.dot_general(
             ds, k_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    dq0 = jnp.zeros((block_q, q_ref.shape[-1]), jnp.float32)
     if causal:
-        num_iters = lax.div((qi + 1) * block_q + block_k - 1, block_k)
-        num_iters = jnp.minimum(num_iters, num_kb)
+        # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
+        pl.when(_causal_live(qi, ki, block_q, block_k))(_compute)
     else:
-        num_iters = num_kb
-    dq = lax.fori_loop(0, num_iters, body, dq0)
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+        _compute()
+
+    @pl.when(ki == num_kb - 1)
+    def _write():
+        dq_ref[0] = (dq_scr[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale: float, causal: bool,
-                    block_q: int, block_k: int, seq_len: int, real_len: int):
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                    causal: bool, block_q: int, block_k: int, num_qb: int,
+                    real_len: int, seq_len: int):
     ki = pl.program_id(1)
-    k_blk = k_ref[0].astype(jnp.float32)     # [block_k, D]
-    v_blk = v_ref[0].astype(jnp.float32)     # [block_k, D]
-    num_qb = seq_len // block_q
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
     rows = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
     cols = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :].astype(jnp.float32)
-        lse = _cols(lse_ref[0, pl.ds(qb * block_q, block_q), :], block_k)
-        delta = _cols(delta_ref[0, pl.ds(qb * block_q, block_q), :], block_k)
+    def _compute():
+        k_blk = k_ref[0].astype(jnp.float32)     # [block_k, D]
+        v_blk = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)         # [block_q, D]
+        do = do_ref[0].astype(jnp.float32)
+        lse = _cols(lse_ref[0], block_k)
+        delta = _cols(delta_ref[0], block_k)
         s = jax.lax.dot_general(
             q * scale, k_blk,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
-        q_pos = qb * block_q + rows
+        q_pos = qi * block_q + rows
         k_pos = ki * block_k + cols
         if causal:
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
@@ -274,7 +331,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(q_pos < real_len, s, NEG_INF)
             s = jnp.where(k_pos < real_len, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dv_new = dv + jax.lax.dot_general(
+        dv_scr[...] = dv_scr[...] + jax.lax.dot_general(
             p, do,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -284,25 +341,23 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta)                # [block_q, block_k]
-        dk_new = dk + jax.lax.dot_general(
+        ds = p * (dp - delta)                    # [block_q, block_k]
+        dk_scr[...] = dk_scr[...] + jax.lax.dot_general(
             ds, q,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk_new, dv_new
 
-    dk0 = jnp.zeros((block_k, k_ref.shape[-1]), jnp.float32)
-    dv0 = jnp.zeros((block_k, v_ref.shape[-1]), jnp.float32)
     if causal:
-        # Q-blocks strictly before this K-block's first row contribute
-        # nothing under the causal mask; start the walk at the diagonal.
-        start = lax.div(ki * block_k, block_q)
+        # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
+        pl.when(_causal_live(qi, ki, block_q, block_k))(_compute)
     else:
-        start = 0
-    dk, dv = lax.fori_loop(start, num_qb, body, (dk0, dv0))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+        _compute()
+
+    @pl.when(qi == num_qb - 1)
+    def _write():
+        dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
@@ -338,37 +393,45 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     delta = jnp.broadcast_to(delta[:, :, None], (bh, seq_len, LANE))
     lse = jnp.broadcast_to(lse[:, :, None], (bh, seq_len, LANE))
 
+    num_qb = seq_len // block_q
+    num_kb = seq_len // block_k
     common = dict(scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, seq_len=seq_len, real_len=real_len)
-    qspec = pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0))
-    kfull = pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0))
-    qfull = pl.BlockSpec((1, seq_len, head_dim), lambda b, i: (b, 0, 0))
-    rowspec_q = pl.BlockSpec((1, block_q, LANE), lambda b, i: (b, i, 0))
-    rowfull = pl.BlockSpec((1, seq_len, LANE), lambda b, i: (b, 0, 0))
+                  block_k=block_k, real_len=real_len, seq_len=seq_len)
+    # dq pass: grid (bh, q-block, k-block), K innermost (reduction)
+    qspec = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0))
+    kspec_j = pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0))
+    rowspec_q = pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, **common),
+        functools.partial(_bwd_dq_kernel, num_kb=num_kb, **common),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
-        grid=(bh, seq_len // block_q),
-        in_specs=[qspec, kfull, kfull, qspec, rowspec_q, rowspec_q],
-        out_specs=pl.BlockSpec((1, block_q, head_dim), lambda b, i: (b, i, 0)),
+        grid=(bh, num_qb, num_kb),
+        in_specs=[qspec, kspec_j, kspec_j, qspec, rowspec_q, rowspec_q],
+        out_specs=qspec,
+        scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
         interpret=interpret,
+        **_compiler_params(interpret, ("parallel", "parallel", "arbitrary")),
     )(qf, kf, vf, dof, lse, delta)
 
-    kspec = pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0))
+    # dk/dv pass: grid (bh, k-block, q-block), Q innermost (reduction)
+    qspec_j = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, j, 0))
+    kspec_i = pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, i, 0))
+    rowspec_j = pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, j, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
+        functools.partial(_bwd_dkv_kernel, num_qb=num_qb, **common),
         out_shape=(
             jax.ShapeDtypeStruct(kf.shape, k.dtype),
             jax.ShapeDtypeStruct(vf.shape, v.dtype),
         ),
-        grid=(bh, seq_len // block_k),
-        in_specs=[qfull, kspec, kspec, qfull, rowfull, rowfull],
-        out_specs=(
-            pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda b, i: (b, i, 0)),
-        ),
+        grid=(bh, num_kb, num_qb),
+        in_specs=[qspec_j, kspec_i, kspec_i, qspec_j, rowspec_j, rowspec_j],
+        out_specs=(kspec_i, kspec_i),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+            pltpu.VMEM((block_k, head_dim), jnp.float32),
+        ],
         interpret=interpret,
+        **_compiler_params(interpret, ("parallel", "parallel", "arbitrary")),
     )(qf, kf, vf, dof, lse, delta)
 
     def unflat(x):
