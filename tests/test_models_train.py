@@ -162,3 +162,21 @@ def test_graft_entry_forward():
     fn, args = graft.entry()
     out = jax.jit(fn)(*args)
     assert out.shape == (8, 1000)
+
+
+def test_lm_use_flash_false_matches_flash_path():
+    """The bench's baseline arm (use_flash=False -> xla_attention even on
+    TPU) must be numerically identical to the flash path off-TPU, where both
+    resolve to XLA attention — guards the config plumb-through."""
+    cfg = TransformerConfig(
+        vocab_size=128, num_layers=2, num_heads=2, d_model=32, d_ff=64,
+        max_len=32, dtype=jnp.float32,
+    )
+    cfg_xla = TransformerConfig(**{**cfg.__dict__, "use_flash": False})
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, 128)
+    model, model_xla = TransformerLM(cfg), TransformerLM(cfg_xla)
+    params = model.init(jax.random.PRNGKey(1), tokens)
+    out = model.apply(params, tokens)
+    out_xla = model_xla.apply(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(out_xla), atol=1e-5)

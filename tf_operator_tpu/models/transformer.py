@@ -20,7 +20,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from ..ops.attention import flash_attention
+from ..ops.attention import flash_attention, xla_attention
 from ..parallel.ring_attention import ring_attention
 
 
@@ -40,6 +40,9 @@ class TransformerConfig:
     ring_axis: str = "sp"
     mesh: Optional[Any] = None  # jax.sharding.Mesh (static/hashable)
     remat: bool = False
+    # False forces the O(T²) XLA attention path even on TPU — the bench's
+    # baseline arm (flash vs XLA is the framework's own headline comparison).
+    use_flash: bool = True
     # BERT extras
     type_vocab_size: int = 2
     # Mixture-of-Experts: replace the dense MLP with MoEMLP in every
@@ -79,8 +82,10 @@ class SelfAttention(nn.Module):
             out = ring_attention(
                 q, k, v, cfg.mesh, axis_name=cfg.ring_axis, causal=cfg.causal
             )
-        else:
+        elif cfg.use_flash:
             out = flash_attention(q, k, v, cfg.causal)
+        else:
+            out = xla_attention(q, k, v, causal=cfg.causal)
         out = out.transpose(0, 2, 1, 3)  # [B, T, H, D]
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out",
