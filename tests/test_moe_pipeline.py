@@ -170,3 +170,65 @@ class TestPipelinedLM:
             params, loss = step(params)
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+class TestOneFOneB:
+    """1F1B schedule equivalence (round-2 VERDICT #8): same loss AND same
+    grads as the GPipe schedule — the schedules differ only in ordering and
+    activation-memory profile, never numerically."""
+
+    def _model(self, pp=2, dp=4, microbatches=4):
+        mesh = build_mesh({"pp": pp, "dp": dp})
+        cfg = TransformerConfig(
+            vocab_size=64, num_layers=pp, num_heads=2, d_model=16, d_ff=32,
+            max_len=16, dtype=jnp.float32,
+        )
+        model = PipelinedTransformerLM(cfg, mesh, num_microbatches=microbatches)
+        params = model.shard_params(model.init(jax.random.PRNGKey(0)))
+        tokens = jnp.arange(8 * 16, dtype=jnp.int32).reshape(8, 16) % 64
+        return model, params, tokens
+
+    def test_loss_matches_gpipe(self):
+        model, params, tokens = self._model()
+        l_g = jax.jit(model.loss_gpipe)(params, tokens)
+        l_1 = jax.jit(model.loss_1f1b)(params, tokens)
+        np.testing.assert_allclose(float(l_g), float(l_1), rtol=1e-5)
+
+    def test_grads_match_gpipe(self):
+        """The fused manual-VJP loop against autodiff-of-GPipe, covering
+        stage grads, head grads, and the embedding/weight-tying path via
+        the x cotangent."""
+        model, params, tokens = self._model()
+        g_g = jax.jit(jax.grad(model.loss_gpipe))(params, tokens)
+        g_1 = jax.jit(jax.grad(model.loss_1f1b))(params, tokens)
+        flat_g, _ = jax.tree_util.tree_flatten_with_path(g_g)
+        flat_1, _ = jax.tree_util.tree_flatten_with_path(g_1)
+        for (path_g, leaf_g), (path_1, leaf_1) in zip(flat_g, flat_1):
+            assert path_g == path_1
+            np.testing.assert_allclose(
+                np.asarray(leaf_g), np.asarray(leaf_1), atol=2e-4,
+                err_msg=str(path_g),
+            )
+
+    def test_four_stage_warmup_cooldown(self):
+        """P=4 with M=8: multi-stage warmup/cooldown masking."""
+        model, params, tokens = self._model(pp=4, dp=2, microbatches=8)
+        l_g = jax.jit(model.loss_gpipe)(params, tokens)
+        l_1 = jax.jit(model.loss_1f1b)(params, tokens)
+        np.testing.assert_allclose(float(l_g), float(l_1), rtol=1e-5)
+
+    def test_residual_buffer_wraparound(self):
+        """M=8 > nbuf=2P=4 (pp=2): microbatch slots genuinely alias mod the
+        circular buffer, so a slot-liveness regression in one_f_one_b cannot
+        hide — grads must still match autodiff-of-GPipe exactly."""
+        model, params, tokens = self._model(pp=2, dp=4, microbatches=8)
+        l_g = jax.jit(model.loss_gpipe)(params, tokens)
+        l_1 = jax.jit(model.loss_1f1b)(params, tokens)
+        np.testing.assert_allclose(float(l_g), float(l_1), rtol=1e-5)
+        g_g = jax.jit(jax.grad(model.loss_gpipe))(params, tokens)
+        g_1 = jax.jit(jax.grad(model.loss_1f1b))(params, tokens)
+        for leaf_g, leaf_1 in zip(
+            jax.tree_util.tree_leaves(g_g), jax.tree_util.tree_leaves(g_1)
+        ):
+            np.testing.assert_allclose(
+                np.asarray(leaf_g), np.asarray(leaf_1), atol=2e-4)

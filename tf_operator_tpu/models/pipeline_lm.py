@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.pipeline import gpipe
+from ..parallel.pipeline import gpipe, one_f_one_b
 from .transformer import Block, TransformerConfig
 
 
@@ -85,6 +85,27 @@ class PipelinedTransformerLM:
         )
         return x
 
+    def _head_logits(self, hp, act: jax.Array) -> jax.Array:
+        """Final LayerNorm + weight-tied readout.  THE single copy of the
+        head math: apply(), loss_gpipe and loss_1f1b all route through it —
+        the gpipe==1f1b equivalence contract depends on that."""
+        cfg = self.cfg
+        x32 = act.astype(jnp.float32)
+        mean = x32.mean(-1, keepdims=True)
+        var = x32.var(-1, keepdims=True)
+        x32 = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+        x32 = x32 * hp["ln_f_scale"] + hp["ln_f_bias"]
+        logits = x32.astype(cfg.dtype) @ hp["wte"].astype(cfg.dtype).T
+        return logits.astype(jnp.float32)
+
+    @staticmethod
+    def _next_token_loss(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+        ll = jnp.take_along_axis(
+            logp, tokens[:, 1:][..., None], axis=-1
+        )[..., 0]
+        return -jnp.mean(ll)
+
     def apply(self, params, tokens: jax.Array) -> jax.Array:
         cfg = self.cfg
         b, t = tokens.shape
@@ -94,10 +115,36 @@ class PipelinedTransformerLM:
             self._stage_fn, params["stages"], x, self.mesh,
             self.num_microbatches, axis=self.pp_axis,
         )
-        x32 = x.astype(jnp.float32)
-        mean = x32.mean(-1, keepdims=True)
-        var = x32.var(-1, keepdims=True)
-        x32 = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
-        x32 = x32 * params["ln_f_scale"] + params["ln_f_bias"]
-        logits = x32.astype(cfg.dtype) @ params["wte"].astype(cfg.dtype).T
-        return logits.astype(jnp.float32)
+        return self._head_logits(params, x)
+
+    # ------------------------------------------------------------------
+    # losses (both schedules share the head math via _head_logits)
+
+    def _head_loss_fn(self):
+        def head_loss(hp, act, tokens_mb):
+            return self._next_token_loss(self._head_logits(hp, act), tokens_mb)
+
+        return head_loss
+
+    def loss_gpipe(self, params, tokens: jax.Array) -> jax.Array:
+        """Next-token loss through the GPipe schedule (forward pipelined,
+        backward by autodiff — O(M) live microbatch residuals)."""
+        return self._next_token_loss(self.apply(params, tokens), tokens)
+
+    def loss_1f1b(self, params, tokens: jax.Array) -> jax.Array:
+        """Next-token loss through the fused 1F1B schedule (O(P) live
+        microbatch residuals; see parallel/pipeline.one_f_one_b).  Same
+        math as loss_gpipe — the schedules must agree to float tolerance."""
+        cfg = self.cfg
+        b, t = tokens.shape
+        x = params["wte"][tokens] + params["wpe"][None, :t, :]
+        x = x.astype(cfg.dtype)
+        head = {
+            "wte": params["wte"],
+            "ln_f_scale": params["ln_f_scale"],
+            "ln_f_bias": params["ln_f_bias"],
+        }
+        return one_f_one_b(
+            self._stage_fn, self._head_loss_fn(), params["stages"], head,
+            x, tokens, self.mesh, self.num_microbatches, self.pp_axis,
+        )

@@ -86,3 +86,177 @@ def gpipe(
     )
     out_mb = fn(stacked_params, x_mb)
     return out_mb.reshape(batch, *x.shape[1:])
+
+
+# ---------------------------------------------------------------------------
+# 1F1B (one-forward-one-backward) schedule
+
+
+def one_f_one_b(
+    stage_fn: Callable,
+    head_loss_fn: Callable,
+    stacked_params,
+    head_params,
+    x: jax.Array,
+    y: jax.Array,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+) -> jax.Array:
+    """Fused-forward/backward pipeline: returns the scalar mean loss.
+
+    Where `gpipe` pipelines the forward and leaves the backward to autodiff
+    (which replays all M microbatches' residuals — O(M) activation memory),
+    this schedule interleaves one forward and one backward per cycle in a
+    single `lax.fori_loop`: rank r runs the forward of microbatch c-r and
+    the backward of microbatch c-2(P-1)+r at cycle c, so at most O(P)
+    microbatch inputs are ever live (a circular 2P-slot buffer); each
+    backward re-runs its stage forward from the saved input to build the
+    VJP (recompute-style, the TPU-friendly trade of FLOPs for HBM).  The
+    last rank computes `head_loss_fn` and seeds the backward in the same
+    cycle its forward finishes — the 1F1B property.  Total cycles:
+    M + 2(P-1).
+
+    stage_fn(params_r, act) -> act (shape-preserving, as for gpipe).
+    head_loss_fn(head_params, act, y_mb) -> scalar mean loss per microbatch.
+    x: [batch, ...] activations entering stage 0 (embedding applied by the
+    caller so its gradient flows through x's cotangent, weight tying
+    included).  y: [batch, ...] targets, any dtype (int fine).
+
+    Implemented as a custom_vjp whose forward computes loss AND all grads in
+    the fused loop; the backward just scales them by the (scalar) cotangent,
+    so the op composes with outer autodiff/jit like any other loss term.
+    """
+    num_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible by microbatches {num_microbatches}")
+    mb = batch // num_microbatches
+    x_mb = x.reshape(num_microbatches, mb, *x.shape[1:])
+    y_mb = y.reshape(num_microbatches, mb, *y.shape[1:])
+
+    def _fused(stages, head, x_mb, y_mb):
+        """shard_map body: (loss, dstages_local, dhead, dx) on every rank."""
+        rank = lax.axis_index(axis)
+        num_mb = x_mb.shape[0]
+        params_r = jax.tree_util.tree_map(lambda p: p[0], stages)
+        is_last = rank == num_stages - 1
+        nbuf = 2 * num_stages
+        perm_f = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+        perm_b = [(i, (i - 1) % num_stages) for i in range(num_stages)]
+        inv_m = jnp.float32(1.0 / num_mb)
+
+        def cycle(c, state):
+            (res_buf, dx_buf, dstages, dhead, carry_f, carry_b,
+             loss_acc) = state
+            # ---- forward of microbatch f = c - rank ----------------------
+            f = c - rank
+            f_valid = jnp.logical_and(f >= 0, f < num_mb)
+            f_idx = jnp.clip(f, 0, num_mb - 1)
+            feed = lax.dynamic_index_in_dim(x_mb, f_idx, 0, keepdims=False)
+            inp = jnp.where(rank == 0, feed, carry_f)
+            act = stage_fn(params_r, inp)
+            act = jnp.where(f_valid, act, jnp.zeros_like(act))
+            # save the stage INPUT for the recompute-VJP at backward time;
+            # an invalid (warmup/cooldown) forward must leave the slot
+            # untouched — its clipped index aliases a live microbatch's slot
+            slot_f = lax.rem(f_idx, nbuf)
+            cur_slot = lax.dynamic_index_in_dim(
+                res_buf, slot_f, 0, keepdims=False)
+            res_buf = lax.dynamic_update_index_in_dim(
+                res_buf, jnp.where(f_valid, inp, cur_slot), slot_f, 0)
+            # ---- head (last rank only): loss + seed cotangent ------------
+            y_f = lax.dynamic_index_in_dim(y_mb, f_idx, 0, keepdims=False)
+
+            def do_head(a):
+                lv, vjp_h = jax.vjp(
+                    lambda hp, aa: head_loss_fn(hp, aa, y_f), head, a)
+                dh, seed = vjp_h(inv_m)  # 1/M folds the mean over microbatches
+                return lv, dh, seed
+
+            def skip_head(a):
+                return (jnp.float32(0.0),
+                        jax.tree_util.tree_map(jnp.zeros_like, head),
+                        jnp.zeros_like(a))
+
+            lv, dh_f, seed = lax.cond(is_last, do_head, skip_head, act)
+            ok_head = jnp.logical_and(f_valid, is_last)
+            loss_acc = loss_acc + jnp.where(ok_head, lv, 0.0)
+            dhead = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(ok_head, g, jnp.zeros_like(g)),
+                dhead, dh_f)
+            # ---- backward of microbatch b = c - 2(P-1) + rank ------------
+            b = c - 2 * (num_stages - 1) + rank
+            b_valid = jnp.logical_and(b >= 0, b < num_mb)
+            b_idx = jnp.clip(b, 0, num_mb - 1)
+            saved_inp = lax.dynamic_index_in_dim(
+                res_buf, lax.rem(b_idx, nbuf), 0, keepdims=False)
+            # last rank: b == f this cycle, seed is fresh; others: from ring
+            cot = jnp.where(is_last, seed.astype(carry_b.dtype), carry_b)
+            _, vjp_s = jax.vjp(
+                lambda pr, i: stage_fn(pr, i), params_r, saved_inp)
+            dpr, dinp = vjp_s(cot.astype(act.dtype))
+            dstages = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(b_valid, g, jnp.zeros_like(g)),
+                dstages, dpr)
+            dinp = jnp.where(b_valid, dinp, jnp.zeros_like(dinp))
+            # rank 0's dinp is the cotangent of x_mb[b]
+            cur = lax.dynamic_index_in_dim(dx_buf, b_idx, 0, keepdims=False)
+            row = jnp.where(jnp.logical_and(rank == 0, b_valid), dinp, cur)
+            dx_buf = lax.dynamic_update_index_in_dim(dx_buf, row, b_idx, 0)
+            # ---- ring hops -----------------------------------------------
+            carry_f = lax.ppermute(act, axis, perm_f)
+            carry_b = lax.ppermute(dinp, axis, perm_b)
+            return (res_buf, dx_buf, dstages, dhead, carry_f, carry_b,
+                    loss_acc)
+
+        init = (
+            jnp.zeros((nbuf, *x_mb.shape[1:]), x_mb.dtype),
+            jnp.zeros_like(x_mb),
+            jax.tree_util.tree_map(jnp.zeros_like, params_r),
+            jax.tree_util.tree_map(jnp.zeros_like, head),
+            jnp.zeros(x_mb.shape[1:], x_mb.dtype),
+            jnp.zeros(x_mb.shape[1:], x_mb.dtype),
+            jnp.float32(0.0),
+        )
+        total = num_mb + 2 * (num_stages - 1)
+        (_, dx_buf, dstages, dhead, _, _, loss_acc) = lax.fori_loop(
+            0, total, cycle, init)
+        loss = lax.psum(loss_acc, axis) * inv_m
+        dhead = lax.psum(dhead, axis)
+        dx = lax.psum(dx_buf, axis)
+        # each rank's stage grads go back stacked on the pp axis
+        dstages = jax.tree_util.tree_map(lambda t: t[None], dstages)
+        return loss, dstages, dhead, dx
+
+    param_specs = jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params
+    )
+    head_specs = jax.tree_util.tree_map(lambda p: P(), head_params)
+    fused = shard_map(
+        _fused,
+        mesh=mesh,
+        in_specs=(param_specs, head_specs, P(), P()),
+        out_specs=(P(), param_specs, head_specs, P()),
+        check_rep=False,
+    )
+
+    @jax.custom_vjp
+    def pipeline_loss(stages, head, x_mb):
+        loss, _, _, _ = fused(stages, head, x_mb, y_mb)
+        return loss
+
+    def pipeline_loss_fwd(stages, head, x_mb):
+        loss, dstages, dhead, dx = fused(stages, head, x_mb, y_mb)
+        return loss, (dstages, dhead, dx)
+
+    def pipeline_loss_bwd(res, g):
+        dstages, dhead, dx = res
+        scale = lambda t: (t * g).astype(t.dtype)  # noqa: E731
+        return (jax.tree_util.tree_map(scale, dstages),
+                jax.tree_util.tree_map(scale, dhead),
+                scale(dx))
+
+    pipeline_loss.defvjp(pipeline_loss_fwd, pipeline_loss_bwd)
+    return pipeline_loss(stacked_params, head_params, x_mb)
