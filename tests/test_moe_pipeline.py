@@ -191,7 +191,9 @@ class TestOneFOneB:
     def test_loss_matches_gpipe(self):
         model, params, tokens = self._model()
         l_g = jax.jit(model.loss_gpipe)(params, tokens)
-        l_1 = jax.jit(model.loss_1f1b)(params, tokens)
+        # value_and_grad so the loss comes from the FUSED loop (the primal
+        # loss-only path deliberately routes through the gpipe forward)
+        l_1, _ = jax.jit(jax.value_and_grad(model.loss_1f1b))(params, tokens)
         np.testing.assert_allclose(float(l_g), float(l_1), rtol=1e-5)
 
     def test_grads_match_gpipe(self):
@@ -214,7 +216,7 @@ class TestOneFOneB:
         """P=4 with M=8: multi-stage warmup/cooldown masking."""
         model, params, tokens = self._model(pp=4, dp=2, microbatches=8)
         l_g = jax.jit(model.loss_gpipe)(params, tokens)
-        l_1 = jax.jit(model.loss_1f1b)(params, tokens)
+        l_1, _ = jax.jit(jax.value_and_grad(model.loss_1f1b))(params, tokens)
         np.testing.assert_allclose(float(l_g), float(l_1), rtol=1e-5)
 
     def test_residual_buffer_wraparound(self):
@@ -223,7 +225,7 @@ class TestOneFOneB:
         hide — grads must still match autodiff-of-GPipe exactly."""
         model, params, tokens = self._model(pp=2, dp=4, microbatches=8)
         l_g = jax.jit(model.loss_gpipe)(params, tokens)
-        l_1 = jax.jit(model.loss_1f1b)(params, tokens)
+        l_1, _ = jax.jit(jax.value_and_grad(model.loss_1f1b))(params, tokens)
         np.testing.assert_allclose(float(l_g), float(l_1), rtol=1e-5)
         g_g = jax.jit(jax.grad(model.loss_gpipe))(params, tokens)
         g_1 = jax.jit(jax.grad(model.loss_1f1b))(params, tokens)

@@ -13,6 +13,7 @@ from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.types import ReplicaType
 from tf_operator_tpu.api.types import TPUTopology
 from tf_operator_tpu.controller import topology
+from tf_operator_tpu.workloads.runner import runconfig_from_env
 
 from testutil import new_pod, new_tpujob
 
@@ -132,3 +133,73 @@ class TestTPUEnv:
         assert env[constants.ENV_ACCELERATOR] == "v5litepod-8"
         assert env[constants.ENV_SLICE_TOPOLOGY] == "2x4"
         assert json.loads(env[constants.ENV_MESH_SHAPE]) == {"dp": 2, "tp": 4}
+
+
+class TestRunConfigFromEnv:
+    """Consumer-side TF_CONFIG parsing, RunConfig semantics (the reference
+    instantiates TF's real RunConfig in its test-server, test_app.py:35-44;
+    estimator_runconfig_tests.py:26-102 is the assertion contract).  The
+    emitted document and the consumer are tested as a pair: gen_tf_config
+    output feeds runconfig_from_env directly."""
+
+    def _env(self, job, rtype, index, resolver=topology.dns_resolver):
+        return {
+            constants.ENV_TF_CONFIG: topology.gen_tf_config(
+                job, rtype, index, resolver)
+        }
+
+    def _job(self, **kw):
+        return new_tpujob(name="rc", **kw)
+
+    def test_dense_worker(self):
+        job = self._job(worker=2, ps=1, chief=1)
+        rc = runconfig_from_env(self._env(job, ReplicaType.WORKER, 1))
+        assert rc["task_type"] == "worker" and rc["task_id"] == 1
+        assert rc["master"] == "grpc://rc-worker-1.default.svc:2222"
+        assert rc["cluster_spec"]["chief"] == ["rc-chief-0.default.svc:2222"]
+        assert rc["num_worker_replicas"] == 3  # chief is also a worker
+        assert rc["num_ps_replicas"] == 1
+        assert rc["is_chief"] is False
+
+    def test_dense_chief_is_chief(self):
+        job = self._job(worker=2, ps=1, chief=1)
+        rc = runconfig_from_env(self._env(job, ReplicaType.CHIEF, 0))
+        assert rc["is_chief"] is True
+        assert rc["master"] == "grpc://rc-chief-0.default.svc:2222"
+
+    def test_evaluator_outside_cluster(self):
+        job = self._job(worker=1, ps=1, evaluator=1)
+        rc = runconfig_from_env(self._env(job, ReplicaType.EVALUATOR, 0))
+        assert rc == {
+            "task_type": "evaluator", "task_id": 0, "cluster_spec": {},
+            "is_chief": False, "master": "", "num_worker_replicas": 0,
+            "num_ps_replicas": 0,
+        }
+
+    def test_custom_domain(self, monkeypatch):
+        monkeypatch.setenv(constants.ENV_CUSTOM_CLUSTER_DOMAIN, "cluster.local")
+        job = self._job(worker=1, ps=1)
+        rc = runconfig_from_env(self._env(job, ReplicaType.WORKER, 0))
+        assert rc["master"] == "grpc://rc-worker-0.default.svc.cluster.local:2222"
+
+    def test_sparse_worker_sees_self_and_ps(self):
+        job = self._job(worker=3, ps=2)
+        job.spec.enable_dynamic_worker = True
+        rc = runconfig_from_env(self._env(job, ReplicaType.WORKER, 2))
+        assert rc["master"] == "grpc://rc-worker-2.default.svc:2222"
+        assert rc["num_ps_replicas"] == 2
+        assert rc["num_worker_replicas"] == 1  # sparse view: itself only
+        assert rc["cluster_spec"]["worker"] == {
+            "2": "rc-worker-2.default.svc:2222"}
+
+    def test_sparse_ps_sees_itself(self):
+        job = self._job(worker=2, ps=2)
+        job.spec.enable_dynamic_worker = True
+        rc = runconfig_from_env(self._env(job, ReplicaType.PS, 1))
+        assert rc["master"] == "grpc://rc-ps-1.default.svc:2222"
+        assert rc["num_ps_replicas"] == 1
+
+    def test_non_distributed_defaults(self):
+        rc = runconfig_from_env({})
+        assert rc["is_chief"] is True and rc["master"] == ""
+        assert rc["num_worker_replicas"] == 1  # local mode: itself

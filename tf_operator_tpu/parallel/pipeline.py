@@ -244,8 +244,16 @@ def one_f_one_b(
 
     @jax.custom_vjp
     def pipeline_loss(stages, head, x_mb):
-        loss, _, _, _ = fused(stages, head, x_mb, y_mb)
-        return loss
+        # Primal (loss-only) path: a plain forward pipeline — the fused
+        # loop's grad accumulators are loop-carried state XLA cannot
+        # dead-code-eliminate, so running it here would pay ~3x forward
+        # FLOPs for an evaluation.  The fused loop runs only under
+        # differentiation (pipeline_loss_fwd).
+        x_flat = x_mb.reshape(batch, *x_mb.shape[2:])
+        acts = gpipe(stage_fn, stages, x_flat, mesh, num_microbatches, axis)
+        acts_mb = acts.reshape(num_microbatches, mb, *acts.shape[1:])
+        per_mb = jax.vmap(lambda a, t: head_loss_fn(head, a, t))(acts_mb, y_mb)
+        return jnp.mean(per_mb)
 
     def pipeline_loss_fwd(stages, head, x_mb):
         loss, dstages, dhead, dx = fused(stages, head, x_mb, y_mb)

@@ -40,6 +40,16 @@ def main(argv=None) -> int:
     }
     with open(os.path.join(args.ctrl_dir, f"{args.pod_name}.env.json"), "w") as f:
         json.dump(view, f, indent=2)
+    # /runconfig analogue: consume TF_CONFIG in-process with the
+    # RunConfig-shaped resolver (the reference instantiates TF's real
+    # RunConfig here — test_app.py:35-44) so E2E asserts catch a
+    # present-but-malformed topology document, not just a missing one.
+    from .runner import runconfig_from_env
+
+    with open(
+        os.path.join(args.ctrl_dir, f"{args.pod_name}.runconfig.json"), "w"
+    ) as f:
+        json.dump(runconfig_from_env(), f, indent=2)
 
     deadline = (
         time.time() + args.auto_exit_after if args.auto_exit_after is not None else None
