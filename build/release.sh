@@ -1,0 +1,46 @@
+#!/bin/sh
+# Bump all version surfaces in lockstep (docs/releasing.md).
+# Usage: build/release.sh X.Y.Z
+set -eu
+VERSION="${1:?usage: build/release.sh X.Y.Z}"
+case "$VERSION" in
+  *[!0-9.]*) echo "not a semver: $VERSION" >&2; exit 1 ;;
+esac
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+
+OLD="$(python -c "import sys; sys.path.insert(0, '$ROOT'); import tf_operator_tpu as m; print(m.__version__)")"
+
+python - "$VERSION" "$OLD" <<EOF
+import io, re, sys
+version, old = sys.argv[1], sys.argv[2]
+root = "$ROOT"
+
+def sub(path, pattern, repl, count=1):
+    with io.open(path) as f:
+        src = f.read()
+    out, n = re.subn(pattern, repl, src, count=count)
+    if n != count:
+        raise SystemExit(f"{path}: expected {count} substitution(s), got {n}")
+    with io.open(path, "w") as f:
+        f.write(out)
+
+sub(f"{root}/tf_operator_tpu/__init__.py",
+    r'__version__ = "[^"]+"', f'__version__ = "{version}"')
+sub(f"{root}/manifests/kustomization.yaml",
+    r"newTag: v[0-9.]+", f"newTag: v{version}")
+sub(f"{root}/manifests/deployment.yaml",
+    r"image: tpu-operator:v[0-9.]+", f"image: tpu-operator:v{version}")
+
+# changelog stub (idempotent)
+with io.open(f"{root}/CHANGELOG.md") as f:
+    log = f.read()
+if f"## v{version}" not in log:
+    marker = f"## v{old}"
+    stub = f"## v{version}\n\n- TODO: release notes.\n\n"
+    log = log.replace(marker, stub + marker, 1)
+    with io.open(f"{root}/CHANGELOG.md", "w") as f:
+        f.write(log)
+print(f"bumped {old} -> {version}")
+EOF
+
+cd "$ROOT" && python -m pytest tests/test_release.py -q

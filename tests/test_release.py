@@ -1,0 +1,29 @@
+"""Release hygiene: the version surfaces cannot drift (docs/releasing.md).
+
+The reference ships a documented release flow (releasing.md) with a pinned
+operator image per release; here the pin is enforced mechanically."""
+import re
+from pathlib import Path
+
+import tf_operator_tpu
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_kustomization_pin_matches_package_version():
+    text = (REPO / "manifests" / "kustomization.yaml").read_text()
+    m = re.search(r"newTag: v([0-9.]+)", text)
+    assert m, "kustomization.yaml must pin a versioned newTag"
+    assert m.group(1) == tf_operator_tpu.__version__
+
+
+def test_deployment_image_matches_package_version():
+    text = (REPO / "manifests" / "deployment.yaml").read_text()
+    m = re.search(r"image: tpu-operator:v([0-9.]+)", text)
+    assert m, "deployment.yaml must pin a versioned image tag"
+    assert m.group(1) == tf_operator_tpu.__version__
+
+
+def test_changelog_has_current_version():
+    log = (REPO / "CHANGELOG.md").read_text()
+    assert f"## v{tf_operator_tpu.__version__}" in log

@@ -22,4 +22,4 @@ Layer map (mirrors SURVEY.md §1):
   workloads/  — runnable pod entrypoints (the "user container" side)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"

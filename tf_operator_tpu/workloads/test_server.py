@@ -22,6 +22,15 @@ import sys
 import time
 
 
+def _dump_atomic(dirpath: str, name: str, payload) -> None:
+    """Write-then-rename so watchers that glob for the file never read a
+    partially written document."""
+    tmp = os.path.join(dirpath, f".{name}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=2)
+    os.replace(tmp, os.path.join(dirpath, name))
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--ctrl-dir", required=True)
@@ -38,18 +47,15 @@ def main(argv=None) -> int:
         for key, value in os.environ.items()
         if key.startswith("TPUJOB_") or key == "TF_CONFIG"
     }
-    with open(os.path.join(args.ctrl_dir, f"{args.pod_name}.env.json"), "w") as f:
-        json.dump(view, f, indent=2)
+    _dump_atomic(args.ctrl_dir, f"{args.pod_name}.env.json", view)
     # /runconfig analogue: consume TF_CONFIG in-process with the
     # RunConfig-shaped resolver (the reference instantiates TF's real
     # RunConfig here — test_app.py:35-44) so E2E asserts catch a
     # present-but-malformed topology document, not just a missing one.
     from .runner import runconfig_from_env
 
-    with open(
-        os.path.join(args.ctrl_dir, f"{args.pod_name}.runconfig.json"), "w"
-    ) as f:
-        json.dump(runconfig_from_env(), f, indent=2)
+    _dump_atomic(args.ctrl_dir, f"{args.pod_name}.runconfig.json",
+                 runconfig_from_env())
 
     deadline = (
         time.time() + args.auto_exit_after if args.auto_exit_after is not None else None
