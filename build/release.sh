@@ -4,7 +4,9 @@
 set -eu
 VERSION="${1:?usage: build/release.sh X.Y.Z}"
 case "$VERSION" in
-  *[!0-9.]*) echo "not a semver: $VERSION" >&2; exit 1 ;;
+  *[!0-9.]*|*..*|.*|*.|*.*.*.*) echo "not a semver: $VERSION" >&2; exit 1 ;;
+  *.*.*) : ;;
+  *) echo "not a semver (need X.Y.Z): $VERSION" >&2; exit 1 ;;
 esac
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 

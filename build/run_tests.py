@@ -30,13 +30,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ROOT = REPO  # overridable with --root (tests point it at a sandbox)
 
 
-def failed_node_ids(junit_path: str) -> list[str]:
-    """Node ids of failed/errored testcases in a junit XML file."""
+def failed_node_ids(junit_path: str) -> tuple[list[str], int]:
+    """(node ids of failed/errored testcases, count of failed cases whose
+    classname could not be mapped back to a file under --root).  Unmappable
+    failures must be treated as hard failures by the caller — dropping them
+    would let a retry of the mappable ones flip a failing tier green."""
     try:
         root = ET.parse(junit_path).getroot()
     except (ET.ParseError, OSError):
-        return []
+        return [], 0
     out = []
+    unmappable = 0
     for case in root.iter("testcase"):
         if case.find("failure") is not None or case.find("error") is not None:
             classname = case.get("classname", "")
@@ -53,10 +57,11 @@ def failed_node_ids(junit_path: str) -> list[str]:
                     cls = parts[i:]
                     break
             if path is None:
+                unmappable += 1
                 continue
             node = path + "::" + "::".join(cls + [name]) if cls else path + "::" + name
             out.append(node)
-    return out
+    return out, unmappable
 
 
 def run_pytest(args_list: list[str], junit_path: str) -> int:
@@ -91,7 +96,13 @@ def main(argv=None) -> int:
     rc = run_pytest(base_args, first_xml)
     attempts = 1
     flaked: list[str] = []
-    remaining = failed_node_ids(first_xml) if rc != 0 else []
+    remaining, unmappable = failed_node_ids(first_xml) if rc != 0 else ([], 0)
+    if unmappable:
+        # failures we cannot re-run individually: the tier fails outright
+        print(f"RESULT tier={args.tier} attempts=1 status=fail "
+              f"({unmappable} failed case(s) unmappable to node ids)",
+              flush=True)
+        return 1
     if rc != 0 and not remaining:
         # pytest died before writing junit (collection error etc.) — no
         # retry target; that is a hard failure.
@@ -107,7 +118,11 @@ def main(argv=None) -> int:
         rc = run_pytest(remaining, retry_xml)
         attempts += 1
         if rc != 0:
-            still = failed_node_ids(retry_xml)
+            still, unmappable = failed_node_ids(retry_xml)
+            if unmappable:
+                print(f"retry junit has {unmappable} unmappable failed "
+                      f"case(s); treating the attempt as failed", flush=True)
+                break
             if not still:
                 # pytest died without a parseable junit (segfault, collection
                 # error): NOT a pass — everything outstanding stays failed.

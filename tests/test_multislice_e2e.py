@@ -56,9 +56,19 @@ def test_multislice_document_consumed_by_real_processes(tmp_path):
         )
         client.create(job)
         client.wait_for_job("mslice", timeout=180)
-        logs = client.get_logs("mslice")
-        assert client.is_job_succeeded("mslice"), logs
-        ok = [n for n, t in logs.items() if "multislice_check OK" in t]
+        assert client.is_job_succeeded("mslice")
+        # The default success policy fires on worker-0 completion, so the
+        # other replicas may still be flushing their last log line — poll
+        # until every replica's OK marker lands (or the deadline trips).
+        import time as _time
+
+        deadline = _time.time() + 30
+        while True:
+            logs = client.get_logs("mslice")
+            ok = [n for n, t in logs.items() if "multislice_check OK" in t]
+            if len(ok) == 4 or _time.time() > deadline:
+                break
+            _time.sleep(0.2)
         assert len(ok) == 4, logs
     finally:
         controller.stop()

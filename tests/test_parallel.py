@@ -84,16 +84,17 @@ class TestTPRules:
 
 
 class TestRingAttention:
+    @pytest.mark.parametrize("use_flash", [True, False])
     @pytest.mark.parametrize("causal", [True, False])
     @pytest.mark.parametrize("sp", [2, 4, 8])
-    def test_matches_reference(self, causal, sp):
+    def test_matches_reference(self, causal, sp, use_flash):
         mesh = build_mesh({"dp": 8 // sp, "sp": sp})
         b, h, t, d = 2, 2, 64, 16
         keys = jax.random.split(jax.random.PRNGKey(0), 3)
         q = jax.random.normal(keys[0], (b, h, t, d))
         k = jax.random.normal(keys[1], (b, h, t, d))
         v = jax.random.normal(keys[2], (b, h, t, d))
-        out = ring_attention(q, k, v, mesh, causal=causal)
+        out = ring_attention(q, k, v, mesh, causal=causal, use_flash=use_flash)
         ref = reference_attention(q, k, v, causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
@@ -111,7 +112,11 @@ class TestRingAttention:
             np.asarray(out, np.float32), np.asarray(ref, np.float32), atol=3e-2
         )
 
-    def test_grad_flows(self):
+    @pytest.mark.parametrize("use_flash", [True, False])
+    def test_grad_flows(self, use_flash):
+        """Grads through the ring — for the flash path this includes the
+        lse cotangent flowing through the log-sum-exp combine into the
+        kernel's extended backward (delta' = delta - dlse)."""
         mesh = build_mesh({"sp": 4, "dp": 2})
         b, h, t, d = 2, 2, 32, 8
         keys = jax.random.split(jax.random.PRNGKey(2), 3)
@@ -120,7 +125,8 @@ class TestRingAttention:
         v = jax.random.normal(keys[2], (b, h, t, d))
 
         def loss_ring(q, k, v):
-            return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+            return jnp.sum(ring_attention(
+                q, k, v, mesh, causal=True, use_flash=use_flash) ** 2)
 
         def loss_ref(q, k, v):
             return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)

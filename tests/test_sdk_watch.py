@@ -65,7 +65,8 @@ def test_watch_times_out():
 def test_version_info_shape():
     info = version_info()
     assert set(info) == {"version", "git_sha", "python", "platform"}
-    assert info["version"] == "0.1.0"
+    import tf_operator_tpu
+    assert info["version"] == tf_operator_tpu.__version__
     text = version_string()
-    assert text.startswith("tpu-operator 0.1.0")
+    assert text.startswith(f"tpu-operator {tf_operator_tpu.__version__}")
     assert "python" in text

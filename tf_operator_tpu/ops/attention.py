@@ -361,7 +361,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
-                    block_q: int, block_k: int, interpret: bool):
+                    block_q: int, block_k: int, interpret: bool,
+                    g_lse=None):
+    """dq/dk/dv for cotangent g on the output — and, when `g_lse` [bh, T] is
+    given, also for a cotangent on the lse auxiliary output.  dlse folds
+    into the existing row-scalar plumbing with no kernel change:
+    ds = p·(dp − delta + dlse) = p·(dp − (delta − dlse)), since
+    ∂lse_i/∂s_ij = p_ij — so the kernels just receive delta' = delta − dlse."""
     batch, heads, real_len, head_dim = q.shape
     block_q = min(block_q, max(real_len, 1))
     block_k = min(block_k, max(real_len, 1))
@@ -383,6 +389,8 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     delta = jnp.sum(
         g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     ).reshape(bh, real_len)
+    if g_lse is not None:
+        delta = delta - g_lse.reshape(bh, real_len).astype(jnp.float32)
     pad = seq_len - real_len
     if pad:
         delta = jnp.pad(delta, ((0, 0), (0, pad)))
@@ -446,18 +454,7 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
 
 def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None):
     """Plain-XLA attention (fallback + reference for kernel tests)."""
-    if scale is None:
-        scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        t_q, t_k = logits.shape[-2:]
-        rows = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0)
-        cols = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
-        logits = jnp.where(rows >= cols, logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v).astype(q.dtype)
+    return xla_attention_lse(q, k, v, causal=causal, scale=scale)[0]
 
 
 def _on_tpu() -> bool:
@@ -504,6 +501,75 @@ flash_attention.defvjp(_fwd, _bwd)
 
 
 # ---------------------------------------------------------------------------
+# (output, logsumexp) variant — the building block ring attention combines
+# across devices: per-shard normalized output + per-row lse of the scaled
+# scores, merged in log-sum-exp form (parallel/ring_attention.py).
+
+
+def xla_attention_lse(q, k, v, *, causal: bool = True,
+                      scale: Optional[float] = None):
+    """Closed-form (o, lse [B,H,T] f32) — fallback + oracle for the kernel."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        t_q, t_k = logits.shape[-2:]
+        rows = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
+        logits = jnp.where(rows >= cols, logits, NEG_INF)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    probs = jnp.exp(logits - lse[..., None]).astype(v.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v).astype(q.dtype)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_lse(q, k, v, causal=True, scale=None,
+                        block_q=128, block_k=128):
+    """Fused attention returning (o, lse [B,H,T] f32); Pallas on TPU, XLA
+    elsewhere.  Differentiable in BOTH outputs (the lse cotangent folds into
+    the backward's delta term — see _flash_backward)."""
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    if _on_tpu():
+        batch, heads, t, _ = q.shape
+        out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
+                                  interpret=False)
+        return out, lse[:, :t].reshape(batch, heads, t)
+    return xla_attention_lse(q, k, v, causal=causal, scale=s)
+
+
+def _fwd_lse(q, k, v, causal, scale, block_q, block_k):
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    if _on_tpu():
+        batch, heads, t, _ = q.shape
+        out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
+                                  interpret=False)
+        return (out, lse[:, :t].reshape(batch, heads, t)), (q, k, v, out, lse)
+    out, lse = xla_attention_lse(q, k, v, causal=causal, scale=s)
+    return (out, lse), (q, k, v, None, None)
+
+
+def _bwd_lse(causal, scale, block_q, block_k, res, gs):
+    q, k, v, o, lse = res
+    g_o, g_lse = gs
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    if lse is not None:
+        return _flash_backward(q, k, v, o, lse, g_o, s, causal,
+                               block_q, block_k, interpret=False,
+                               g_lse=g_lse)
+    _, vjp = jax.vjp(
+        lambda q, k, v: xla_attention_lse(q, k, v, causal=causal, scale=s),
+        q, k, v,
+    )
+    return vjp((g_o, g_lse))
+
+
+flash_attention_lse.defvjp(_fwd_lse, _bwd_lse)
+
+
+# ---------------------------------------------------------------------------
 # interpret-mode entry points (CPU correctness tests for the kernels)
 
 
@@ -528,3 +594,18 @@ def flash_attention_grads_interpret(q, k, v, g, causal=True, scale=None,
     dq, dk, dv = _flash_backward(q, k, v, out, lse, g, s, causal,
                                  block_q, block_k, interpret=True)
     return out, dq, dk, dv
+
+
+def flash_attention_lse_grads_interpret(q, k, v, g_o, g_lse, causal=True,
+                                        scale=None, block_q=128, block_k=128):
+    """Interpreter-mode (o, lse) fwd + bwd with cotangents on BOTH outputs —
+    the CPU-testable path through the kernels the TPU compiles for ring
+    attention's per-shard step."""
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    batch, heads, t, _ = q.shape
+    out, lse2 = _flash_forward(q, k, v, s, causal, block_q, block_k,
+                               interpret=True)
+    dq, dk, dv = _flash_backward(q, k, v, out, lse2, g_o, s, causal,
+                                 block_q, block_k, interpret=True,
+                                 g_lse=g_lse)
+    return out, lse2[:, :t].reshape(batch, heads, t), dq, dk, dv

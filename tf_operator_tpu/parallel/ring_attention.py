@@ -25,6 +25,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..ops.attention import flash_attention_lse
+
 try:
     from jax import shard_map as _shard_map  # jax >= 0.8 (check_vma kwarg)
 
@@ -68,7 +70,8 @@ def _combine(acc_o, acc_m, acc_l, o, m, l):
 
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float):
     """Per-device body under shard_map: q/k/v are the local sequence shards
-    [B, H, T_local, D]."""
+    [B, H, T_local, D].  Pure-XLA hop math (O(T_local²) logits per hop) —
+    the flash-kernel variant below is the default on TPU."""
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     t_local = q.shape[2]
@@ -110,6 +113,67 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool, scale: float
     return (acc_o / denom[..., None]).astype(q.dtype)
 
 
+def _ring_attention_local_flash(q, k, v, *, axis_name: str, causal: bool,
+                                scale: float):
+    """Per-device body with the Pallas flash kernel as the hop primitive.
+
+    Each hop runs ops/attention.flash_attention_lse on (local q, arriving
+    K/V block) — O(block²) score tiles stay in VMEM instead of an
+    O(T_local²) logits array in HBM — and the (normalized o, lse) pairs are
+    merged in log-sum-exp form.  Under the global causal mask a hop is one
+    of three cases, chosen per device per step with lax.switch (both
+    branches of every hop are compiled once; each device executes one):
+
+        src block before mine  -> full (non-causal) attention
+        src block is mine      -> standard causal diagonal
+        src block after mine   -> no contribution (lse = -inf sentinel)
+
+    The lse cotangent flows through the combine weights into the kernel's
+    backward (flash_attention_lse is differentiable in both outputs).
+    """
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+
+    def full_hop(q, k_blk, v_blk):
+        o, lse = flash_attention_lse(q, k_blk, v_blk, False, scale)
+        return o.astype(jnp.float32), lse
+
+    def diag_hop(q, k_blk, v_blk):
+        o, lse = flash_attention_lse(q, k_blk, v_blk, True, scale)
+        return o.astype(jnp.float32), lse
+
+    def skip_hop(q, k_blk, v_blk):
+        return (jnp.zeros(q.shape[:3] + (v_blk.shape[-1],), jnp.float32),
+                jnp.full(q.shape[:3], NEG_INF, jnp.float32))
+
+    def step(carry, step_idx):
+        acc_o, acc_lse, k_blk, v_blk = carry
+        src_idx = (my_idx - step_idx) % n
+        if causal:
+            branch = jnp.where(
+                src_idx == my_idx, 1, jnp.where(src_idx < my_idx, 0, 2))
+            o, lse = lax.switch(
+                branch, (full_hop, diag_hop, skip_hop), q, k_blk, v_blk)
+        else:
+            o, lse = full_hop(q, k_blk, v_blk)
+        # log-sum-exp merge of normalized contributions
+        new_lse = jnp.logaddexp(acc_lse, lse)
+        w_acc = jnp.exp(acc_lse - new_lse)[..., None]
+        w_new = jnp.exp(lse - new_lse)[..., None]
+        acc_o = acc_o * w_acc + o * w_new
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_next = lax.ppermute(k_blk, axis_name, perm)
+        v_next = lax.ppermute(v_blk, axis_name, perm)
+        return (acc_o, new_lse, k_next, v_next), None
+
+    acc_o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    acc_lse = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+    (acc_o, _, _, _), _ = lax.scan(
+        step, (acc_o, acc_lse, k, v), jnp.arange(n)
+    )
+    return acc_o.astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -119,18 +183,23 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     scale: Optional[float] = None,
+    use_flash: bool = True,
 ) -> jax.Array:
     """Exact attention with the sequence axis sharded over `axis_name`.
 
     Inputs are global arrays [B, H, T, D] (sharded or to-be-sharded on T);
-    output matches q's shape/dtype.  T must divide evenly by the sp axis size.
+    output matches q's shape/dtype.  T must divide evenly by the sp axis
+    size.  use_flash=True (default) runs the Pallas flash kernel per hop on
+    TPU (falling back to closed-form XLA off-TPU inside the op);
+    use_flash=False keeps the pure-einsum hop math.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    local = _ring_attention_local_flash if use_flash else _ring_attention_local
     spec = P(None, None, axis_name, None)
     fn = shard_map(
         functools.partial(
-            _ring_attention_local, axis_name=axis_name, causal=causal, scale=scale
+            local, axis_name=axis_name, causal=causal, scale=scale
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
