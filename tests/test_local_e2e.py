@@ -403,3 +403,57 @@ class TestRunConfigConsumer:
             "num_ps_replicas": 0,
         })
         (ctrl / "all.cmd").write_text("exit 0")
+
+
+class TestEstimatorWorkload:
+    """estimator-API parity (reference examples/v1/distribution_strategy/
+    estimator-API): a chief+ps+worker+evaluator job where every replica's
+    behavior is chosen from the parsed RunConfig alone — the chief trains
+    and checkpoints, the worker trains, the PS serves shards over the
+    addresses in the RunConfig cluster view, and the evaluator consumes the
+    chief's checkpoints until DONE."""
+
+    def test_train_and_evaluate(self, local_stack):
+        cluster, controller, client, tmp = local_stack
+        model_dir = tmp / "model"
+
+        def spec():
+            return PodTemplateSpec(containers=[Container(
+                name="tensorflow", image="local",
+                command=[sys.executable, "-m",
+                         "tf_operator_tpu.workloads.estimator"],
+                args=["--steps", "30", "--checkpoint-every", "10",
+                      "--model-dir", str(model_dir)],
+            )])
+
+        from tf_operator_tpu.api.types import CleanPodPolicy, RunPolicy
+
+        job = TPUJob(
+            metadata=ObjectMeta(name="estimator"),
+            spec=TPUJobSpec(replica_specs={
+                ReplicaType.CHIEF: ReplicaSpec(replicas=1, template=spec()),
+                ReplicaType.PS: ReplicaSpec(replicas=1, template=spec()),
+                ReplicaType.WORKER: ReplicaSpec(replicas=1, template=spec()),
+                ReplicaType.EVALUATOR: ReplicaSpec(replicas=1, template=spec()),
+            }, run_policy=RunPolicy(
+                # keep pods (and their logs) after the chief-completion
+                # success so the evaluator's output stays observable
+                clean_pod_policy=CleanPodPolicy.NONE,
+            )),
+        )
+        client.create(job)
+        # chief-present success rule: job Succeeded when the chief completes
+        client.wait_for_job("estimator", timeout=120)
+        assert client.is_job_succeeded("estimator")
+        # chief wrote checkpoints + DONE; evaluator consumed at least one
+        assert (model_dir / "DONE").exists()
+        assert list(model_dir.glob("ckpt-*.npz"))
+        deadline = time.time() + 30
+        eval_log = ""
+        while time.time() < deadline:
+            eval_log = client.get_logs("estimator").get(
+                "estimator-evaluator-0", "")
+            if "evaluator done" in eval_log:
+                break
+            time.sleep(0.2)
+        assert "eval step=" in eval_log and "evaluator done" in eval_log, eval_log

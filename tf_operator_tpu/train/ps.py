@@ -25,6 +25,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -218,3 +219,48 @@ def unflatten_params(flat: Dict[str, np.ndarray]):
             node = node.setdefault(part, {})
         node[parts[-1]] = value
     return tree
+
+
+def serve_shard(flat_init: Dict[str, np.ndarray], ps_addresses: List[str],
+                task_id: int, lr: float, native: bool = False):
+    """Stand up THIS replica's parameter-server shard and block until a
+    client sends shutdown.  Shared by every PS-strategy workload (dist_mnist,
+    estimator) so transport selection and shard/port wiring cannot drift
+    between them.  Returns 0 (exit code)."""
+    my_names = shard_names(sorted(flat_init), len(ps_addresses), task_id)
+    shard = {n: flat_init[n] for n in my_names}
+    _, _, port = ps_addresses[task_id].rpartition(":")
+    if native:
+        from . import native_ps
+
+        server = native_ps.NativeParameterServer(
+            ("0.0.0.0", int(port)), shard, lr=lr)
+    else:
+        server = ParameterServer(("0.0.0.0", int(port)), shard, lr=lr)
+    print(f"ps {task_id} ({'native' if native else 'python'}) serving "
+          f"{len(shard)} leaves on :{port}", flush=True)
+    server.serve_until_shutdown()
+    print("ps shutdown", flush=True)
+    return 0
+
+
+def connect_with_retry(ps_addresses: List[str], native: bool = False,
+                       attempts: int = 60, delay: float = 1.0):
+    """Client to all PS shards, retrying the first pull until the servers
+    come up (PS pods may start after workers).  Returns (client, first_flat)
+    or raises ConnectionError after `attempts`."""
+    for _ in range(attempts):
+        if native:
+            from . import native_ps
+
+            client = native_ps.NativePSClient(ps_addresses)
+        else:
+            client = PSClient(ps_addresses)
+        try:
+            return client, client.pull()
+        except (OSError, ConnectionError):
+            client.close()
+            time.sleep(delay)
+    raise ConnectionError(
+        f"could not reach parameter servers {ps_addresses} "
+        f"after {attempts} attempts")

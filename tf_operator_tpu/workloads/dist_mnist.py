@@ -18,7 +18,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-import time
 
 
 def main(argv=None) -> int:
@@ -81,38 +80,15 @@ def main(argv=None) -> int:
 
     if ctx.replica_type == "ps":
         # Serve this shard until a worker sends shutdown (or we are reaped).
-        my_names = ps_lib.shard_names(
-            sorted(flat_init), len(ps_addresses), ctx.replica_index
-        )
-        shard = {n: flat_init[n] for n in my_names}
-        _, _, port = ps_addresses[ctx.replica_index].rpartition(":")
-        if native:
-            server = native_ps.NativeParameterServer(
-                ("0.0.0.0", int(port)), shard, lr=args.lr
-            )
-        else:
-            server = ps_lib.ParameterServer(("0.0.0.0", int(port)), shard, lr=args.lr)
-        print(f"ps {ctx.replica_index} ({'native' if native else 'python'}) "
-              f"serving {len(shard)} leaves on :{port}", flush=True)
-        server.serve_until_shutdown()
-        print("ps shutdown", flush=True)
-        return 0
+        return ps_lib.serve_shard(
+            flat_init, ps_addresses, ctx.replica_index, args.lr,
+            native=native)
 
     # --- worker ---
-    if native:
-        client = native_ps.NativePSClient(ps_addresses)
-    else:
-        client = ps_lib.PSClient(ps_addresses)
-    # PS processes may come up after us; retry the first pull.
-    for attempt in range(60):
-        try:
-            flat = client.pull()
-            break
-        except (OSError, ConnectionError):
-            client.close()
-            time.sleep(1.0)
-    else:
-        print("could not reach parameter servers", flush=True)
+    try:
+        client, flat = ps_lib.connect_with_retry(ps_addresses, native=native)
+    except ConnectionError as e:
+        print(str(e), flush=True)
         return 1
 
     @jax.jit

@@ -1,0 +1,180 @@
+"""Estimator-style workload: every decision comes from the parsed RunConfig.
+
+The reference's estimator-API example relies on TF Estimator reading
+`RunConfig` (cluster spec, task, is_chief, replica counts) and choosing its
+behavior from those fields alone
+(/root/reference/examples/v1/distribution_strategy/estimator-API/,
+estimator_runconfig_tests.py:26-102 asserts the fields).  This workload is
+the JAX-native equivalent of `train_and_evaluate`: it consumes ONLY
+`workloads/runner.runconfig_from_env` — never raw env — and dispatches:
+
+    ps         -> serve a parameter shard (train/ps.py)
+    evaluator  -> poll model_dir for checkpoints the chief writes, evaluate
+                  each, exit when the chief publishes DONE
+    chief      -> train (PS strategy when num_ps_replicas > 0, else local),
+                  checkpoint to model_dir, publish DONE (is_chief=True is
+                  the only replica that writes)
+    worker     -> train the same way, write nothing
+
+A wrong RunConfig therefore fails by behavior: a worker that wrongly sees
+is_chief=True double-writes DONE; a chief with a bad master/cluster view
+cannot reach its PS shards.
+
+Usage: python -m tf_operator_tpu.workloads.estimator --steps 60 \
+           --model-dir /tmp/model
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _save_checkpoint(model_dir: str, step: int, flat_params) -> None:
+    import numpy as np
+
+    os.makedirs(model_dir, exist_ok=True)
+    # .npz suffix on the temp name too — np.savez appends one otherwise
+    tmp = os.path.join(model_dir, f".ckpt-{step}.tmp.npz")
+    np.savez(tmp, **flat_params)
+    os.replace(tmp, os.path.join(model_dir, f"ckpt-{step}.npz"))
+
+
+def _latest_checkpoint(model_dir: str):
+    try:
+        names = [n for n in os.listdir(model_dir)
+                 if n.startswith("ckpt-") and n.endswith(".npz")]
+    except OSError:
+        return None, None
+    if not names:
+        return None, None
+    steps = sorted(int(n[5:-4]) for n in names)
+    latest = steps[-1]
+    return latest, os.path.join(model_dir, f"ckpt-{latest}.npz")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=60)
+    parser.add_argument("--batch", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--model-dir", required=True)
+    parser.add_argument("--checkpoint-every", type=int, default=20)
+    parser.add_argument("--eval-timeout", type=float, default=120.0)
+    args = parser.parse_args(argv)
+
+    from .runner import apply_forced_platform, runconfig_from_env
+
+    apply_forced_platform()
+    rc = runconfig_from_env()
+    print(f"estimator: runconfig={json.dumps(rc)}", flush=True)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models.mnist import MnistMLP
+    from ..train import ps as ps_lib
+    from ..train.data import synthetic_mnist
+
+    model = MnistMLP()
+    init_params = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 784)))["params"]
+    flat_init = ps_lib.flatten_params(init_params)
+    done_path = os.path.join(args.model_dir, "DONE")
+
+    def loss_of(flat, batch):
+        params = ps_lib.unflatten_params(flat)
+        logits = model.apply({"params": params}, batch["x"])
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, batch["label"][:, None], axis=1)
+        )
+
+    # ---- ps: shard server, address from the RunConfig cluster view -------
+    if rc["task_type"] == "ps":
+        return ps_lib.serve_shard(
+            flat_init, list(rc["cluster_spec"].get("ps", [])),
+            rc["task_id"], args.lr)
+
+    # ---- evaluator: consume checkpoints until the chief publishes DONE ---
+    if rc["task_type"] == "evaluator":
+        data = synthetic_mnist(args.batch, seed=999)
+        seen = set()
+        deadline = time.time() + args.eval_timeout
+        while time.time() < deadline:
+            step, path = _latest_checkpoint(args.model_dir)
+            if step is not None and step not in seen:
+                seen.add(step)
+                with np.load(path) as z:
+                    flat = {k: z[k] for k in z.files}
+                loss = float(loss_of(flat, next(data)))
+                print(f"eval step={step} loss={loss:.4f}", flush=True)
+            if os.path.exists(done_path) and seen:
+                print(f"evaluator done ({len(seen)} checkpoint(s))", flush=True)
+                return 0
+            time.sleep(0.2)
+        print("evaluator timed out waiting for checkpoints", flush=True)
+        return 1
+
+    # ---- chief / worker: train, strategy chosen from the RunConfig -------
+    use_ps = rc["num_ps_replicas"] > 0
+    grad_fn = jax.jit(jax.grad(loss_of))
+    data = synthetic_mnist(args.batch, seed=rc["task_id"])
+
+    if use_ps:
+        try:
+            client, flat = ps_lib.connect_with_retry(rc["cluster_spec"]["ps"])
+        except ConnectionError as e:
+            print(str(e), flush=True)
+            return 1
+        for step in range(args.steps):
+            grads = grad_fn(flat, next(data))
+            try:
+                client.push(ps_lib.flatten_params(grads))
+                flat = client.pull()
+            except (OSError, ConnectionError):
+                if os.path.exists(done_path):
+                    # chief finished and shut the PS fleet down mid-step:
+                    # training is over, not broken
+                    print("PS fleet shut down after DONE; stopping", flush=True)
+                    break
+                raise
+            if rc["is_chief"] and (step + 1) % args.checkpoint_every == 0:
+                _save_checkpoint(args.model_dir, step + 1, flat)
+        if rc["is_chief"] and args.steps % args.checkpoint_every != 0:
+            _save_checkpoint(args.model_dir, args.steps, flat)
+    else:
+        flat = dict(flat_init)
+        for step in range(args.steps):
+            grads = grad_fn(flat, next(data))
+            flat = {k: flat[k] - args.lr * np.asarray(g)
+                    for k, g in ps_lib.flatten_params(grads).items()}
+            if rc["is_chief"] and (step + 1) % args.checkpoint_every == 0:
+                _save_checkpoint(args.model_dir, step + 1, flat)
+        if rc["is_chief"] and args.steps % args.checkpoint_every != 0:
+            _save_checkpoint(args.model_dir, args.steps, flat)
+
+    if rc["is_chief"]:
+        os.makedirs(args.model_dir, exist_ok=True)
+        with open(done_path, "w") as f:
+            f.write("done\n")
+        print("chief: published DONE", flush=True)
+        if use_ps:
+            # shut the PS fleet down so cleanPodPolicy None cannot leak
+            # serving processes (workers racing a final step see DONE and
+            # stop cleanly)
+            try:
+                client.shutdown_servers()
+            except (OSError, ConnectionError):
+                pass
+    if use_ps:
+        client.close()
+    print(f"{rc['task_type']} {rc['task_id']}: finished {args.steps} steps",
+          flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
