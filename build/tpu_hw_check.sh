@@ -1,0 +1,23 @@
+#!/bin/sh
+# One-shot hardware validation: run whenever the (flaky) tunneled TPU is
+# reachable.  Captures the compiled-kernel test tier and the full bench into
+# artifacts/ so hardware evidence survives tunnel outages.
+set -u
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$ROOT"
+STAMP="${1:-manual}"
+mkdir -p artifacts
+
+echo "== probe =="
+timeout 120 python -c "import jax; print(jax.devices())" || {
+  echo "TPU unreachable; aborting"; exit 1; }
+
+echo "== hardware test tier =="
+TPUJOB_TEST_PLATFORM=tpu timeout 1200 python -m pytest tests/ -m tpu -v \
+  2>&1 | tail -40 | tee "artifacts/tpu_tier_${STAMP}.log"
+
+echo "== bench (both models + attention ladder + control plane + native) =="
+timeout 3600 python bench.py 2>&1 | tail -1 \
+  | tee "artifacts/bench_${STAMP}.json"
+
+echo "done: artifacts/tpu_tier_${STAMP}.log artifacts/bench_${STAMP}.json"
