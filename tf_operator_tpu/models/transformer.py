@@ -43,6 +43,15 @@ class TransformerConfig:
     # False forces the O(T²) XLA attention path even on TPU — the bench's
     # baseline arm (flash vs XLA is the framework's own headline comparison).
     use_flash: bool = True
+    # Modern-LM (llama-family) knobs: grouped-query attention (num_kv_heads
+    # < num_heads shares each K/V head across a query group), rotary
+    # position embeddings (replaces the learned wpe table), RMSNorm, and a
+    # SwiGLU MLP.  Defaults reproduce the GPT/BERT-style architecture.
+    num_kv_heads: int = 0          # 0 -> num_heads (plain MHA)
+    use_rope: bool = False
+    rope_theta: float = 10000.0
+    norm: str = "layernorm"        # "layernorm" | "rmsnorm"
+    mlp: str = "gelu"              # "gelu" | "swiglu"
     # BERT extras
     type_vocab_size: int = 2
     # Mixture-of-Experts: replace the dense MLP with MoEMLP in every
@@ -52,6 +61,31 @@ class TransformerConfig:
     moe_every: int = 2
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+
+
+def rope(x, *, theta: float = 10000.0, positions=None):
+    """Rotary position embeddings on [B, H, T, D] (D even): rotate feature
+    pairs by position-dependent angles — relative positions enter attention
+    scores directly, so no learned positional table is needed and sequences
+    extrapolate past the training length."""
+    b, h, t, d = x.shape
+    if positions is None:
+        positions = jnp.arange(t)
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
+    cos = jnp.cos(angles)[None, None]
+    sin = jnp.sin(angles)[None, None]
+    x1, x2 = x[..., 0::2].astype(jnp.float32), x[..., 1::2].astype(jnp.float32)
+    rot = jnp.stack(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).reshape(b, h, t, d)
+    return rot.astype(x.dtype)
+
+
+def _norm(cfg: TransformerConfig, name: str):
+    if cfg.norm == "rmsnorm":
+        return nn.RMSNorm(dtype=jnp.float32, name=name)
+    return nn.LayerNorm(dtype=jnp.float32, name=name)
 
 
 def _use_ring(cfg: TransformerConfig) -> bool:
@@ -69,15 +103,32 @@ class SelfAttention(nn.Module):
     def __call__(self, x, mask=None):
         cfg = self.cfg
         head_dim = cfg.d_model // cfg.num_heads
-        dense = lambda name: nn.DenseGeneral(  # noqa: E731
-            (cfg.num_heads, head_dim), dtype=cfg.dtype, name=name,
-            kernel_init=nn.initializers.normal(0.02),
-        )
-        q = dense("query")(x)
-        k = dense("key")(x)
-        v = dense("value")(x)
+        kv_heads = cfg.num_kv_heads or cfg.num_heads
+        if cfg.num_heads % kv_heads:
+            raise ValueError(
+                f"num_heads {cfg.num_heads} must divide by num_kv_heads {kv_heads}")
+
+        def dense(name, heads):
+            return nn.DenseGeneral(
+                (heads, head_dim), dtype=cfg.dtype, name=name,
+                kernel_init=nn.initializers.normal(0.02),
+            )
+
+        q = dense("query", cfg.num_heads)(x)
+        k = dense("key", kv_heads)(x)
+        v = dense("value", kv_heads)(x)
         # [B, T, H, D] -> [B, H, T, D]
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+        if cfg.use_rope:
+            q = rope(q, theta=cfg.rope_theta)
+            k = rope(k, theta=cfg.rope_theta)
+        if kv_heads != cfg.num_heads:
+            # GQA: repeat each K/V head across its query group OUTSIDE the
+            # attention op — autodiff of the repeat sums dk/dv back over the
+            # group, so the kernels stay head-count agnostic.
+            group = cfg.num_heads // kv_heads
+            k = jnp.repeat(k, group, axis=1)
+            v = jnp.repeat(v, group, axis=1)
         if _use_ring(cfg):
             out = ring_attention(
                 q, k, v, cfg.mesh, axis_name=cfg.ring_axis, causal=cfg.causal
@@ -99,11 +150,19 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="wi",
-                     kernel_init=nn.initializers.normal(0.02))(x)
+        init = nn.initializers.normal(0.02)
+        if cfg.mlp == "swiglu":
+            gate = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                            name="wg", kernel_init=init)(x)
+            up = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                          name="wi", kernel_init=init)(x)
+            h = nn.silu(gate) * up
+            return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                            name="wo", kernel_init=init)(h)
+        h = nn.Dense(cfg.d_ff, dtype=cfg.dtype, name="wi", kernel_init=init)(x)
         h = nn.gelu(h)
         return nn.Dense(cfg.d_model, dtype=cfg.dtype, name="wo",
-                        kernel_init=nn.initializers.normal(0.02))(h)
+                        kernel_init=init)(h)
 
 
 class Block(nn.Module):
@@ -115,7 +174,7 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        ln = lambda name: nn.LayerNorm(dtype=jnp.float32, name=name)  # noqa: E731
+        ln = lambda name: _norm(cfg, name)  # noqa: E731
         x = x + SelfAttention(cfg, name="attn")(ln("ln1")(x).astype(cfg.dtype))
         if self.use_moe:
             from ..parallel.moe import MoEMLP
@@ -142,10 +201,12 @@ class TransformerLM(nn.Module):
         b, t = tokens.shape
         emb = nn.Embed(cfg.vocab_size, cfg.d_model, name="wte",
                        embedding_init=nn.initializers.normal(0.02))
-        pos_emb = self.param(
-            "wpe", nn.initializers.normal(0.02), (cfg.max_len, cfg.d_model)
-        )
-        x = emb(tokens) + pos_emb[None, :t, :]
+        x = emb(tokens)
+        if not cfg.use_rope:  # rotary encodes positions inside attention
+            pos_emb = self.param(
+                "wpe", nn.initializers.normal(0.02), (cfg.max_len, cfg.d_model)
+            )
+            x = x + pos_emb[None, :t, :]
         x = x.astype(cfg.dtype)
         block = Block
         if cfg.remat:
@@ -155,7 +216,7 @@ class TransformerLM(nn.Module):
                 cfg.moe_num_experts > 0 and (i + 1) % cfg.moe_every == 0
             )
             x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = _norm(cfg, "ln_f")(x)
         # Weight-tied readout keeps the big vocab matmul on the MXU in bf16.
         logits = emb.attend(x.astype(cfg.dtype))
         return logits.astype(jnp.float32)
@@ -195,6 +256,19 @@ def bert_base_config(**overrides) -> TransformerConfig:
     base = dict(
         vocab_size=30522, num_layers=12, num_heads=12, d_model=768,
         d_ff=3072, max_len=512, causal=False,
+    )
+    base.update(overrides)
+    return TransformerConfig(**base)
+
+
+def llama_style_config(**overrides) -> TransformerConfig:
+    """Llama-family architecture: RoPE + RMSNorm + SwiGLU + grouped-query
+    attention, no learned positional table.  Sized like the gpt-small preset
+    by default; override freely."""
+    base = dict(
+        vocab_size=32000, num_layers=12, num_heads=12, num_kv_heads=4,
+        d_model=768, d_ff=2048, max_len=2048, causal=True,
+        use_rope=True, norm="rmsnorm", mlp="swiglu",
     )
     base.update(overrides)
     return TransformerConfig(**base)

@@ -29,6 +29,11 @@ def main(argv=None) -> int:
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="enable MoE with this many experts (ep-sharded)")
     parser.add_argument("--moe-aux-weight", type=float, default=0.01)
+    parser.add_argument("--arch", choices=("gpt", "llama"), default="gpt",
+                        help="gpt: learned positions + LayerNorm + GELU; "
+                             "llama: RoPE + RMSNorm + SwiGLU + GQA")
+    parser.add_argument("--kv-heads", type=int, default=0,
+                        help="GQA KV heads for --arch llama (0 = heads/3)")
     args = parser.parse_args(argv)
 
     from .runner import WorkloadContext, apply_forced_platform
@@ -55,12 +60,37 @@ def main(argv=None) -> int:
     )
 
     mesh = ctx.build_mesh()
+    heads = max(1, args.d_model // 64)
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    extra = {}
+    d_ff = args.d_model * 4
+    if args.arch == "llama":
+        if args.kv_heads:
+            kv = args.kv_heads
+            # explicit input is honored or rejected, never silently changed
+            if heads % kv or kv % tp:
+                print(f"--kv-heads {kv} must divide num_heads {heads} and "
+                      f"be divisible by tp={tp}", flush=True)
+                return 2
+        else:
+            kv = max(1, heads // 3)
+            # derived default: largest kv <= heads//3 that divides heads
+            # and shards over the tp axis
+            while kv > 1 and (heads % kv or kv % tp):
+                kv -= 1
+            if heads % kv or kv % tp:
+                kv = heads  # degenerate fall-back: plain MHA
+        extra = dict(num_kv_heads=kv, use_rope=True, norm="rmsnorm",
+                     mlp="swiglu")
+        # SwiGLU has 3 matrices; 8/3 scaling keeps MLP params comparable
+        # to the 2-matrix GELU MLP at 4*d_model
+        d_ff = args.d_model * 8 // 3
     cfg = TransformerConfig(
         vocab_size=args.vocab, num_layers=args.layers,
-        num_heads=max(1, args.d_model // 64), d_model=args.d_model,
-        d_ff=args.d_model * 4, max_len=args.seq_len,
+        num_heads=heads, d_model=args.d_model,
+        d_ff=d_ff, max_len=args.seq_len,
         mesh=mesh, ring_axis="sp", remat=args.remat,
-        moe_num_experts=args.moe_experts,
+        moe_num_experts=args.moe_experts, **extra,
     )
     model = TransformerLM(cfg)
     state = create_train_state(
