@@ -270,9 +270,13 @@ class TestModernLM:
 
     def test_llama_style_tp_sharded(self):
         """GQA under tensor parallelism: kv heads (2) divide the tp axis (2),
-        so head sharding stays legal."""
+        so head sharding stays legal — and the SwiGLU gate wg must carry the
+        same column-parallel spec as wi (not silently replicate)."""
+        from jax.sharding import PartitionSpec as P
+
         from tf_operator_tpu.models.transformer import TransformerLM
         from tf_operator_tpu.parallel.mesh import build_mesh
+        from tf_operator_tpu.parallel.tp_rules import make_param_shardings
         from tf_operator_tpu.train.state import create_train_state
         from tf_operator_tpu.train.step import (
             lm_loss_fn, make_train_step, shard_batch, shard_train_state,
@@ -284,7 +288,43 @@ class TestModernLM:
         toks = jax.random.randint(jax.random.PRNGKey(0), (8, 33), 0, 256)
         state = create_train_state(
             jax.random.PRNGKey(1), model, optax.adam(1e-3), toks[:2, :-1])
+        sh = make_param_shardings(state.params, mesh)
+        blk = sh["block_0"]["mlp"]
+        assert blk["wg"]["kernel"].spec == blk["wi"]["kernel"].spec == P(None, "tp")
         state = shard_train_state(state, mesh)
         step = make_train_step(lm_loss_fn(model.apply))
         state, metrics = step(state, shard_batch({"tokens": toks}, mesh))
         assert np.isfinite(float(metrics["loss"]))
+
+    def test_config_rejects_typos(self):
+        """Unknown norm/mlp strings and rope-with-odd-head-dim must raise at
+        config construction, not silently build the default architecture."""
+        from tf_operator_tpu.models.transformer import TransformerConfig
+
+        with pytest.raises(ValueError, match="norm"):
+            TransformerConfig(norm="rms_norm")
+        with pytest.raises(ValueError, match="mlp"):
+            TransformerConfig(mlp="swi-glu")
+        with pytest.raises(ValueError, match="head_dim"):
+            TransformerConfig(use_rope=True, d_model=99, num_heads=1)
+        # kv-heads range/divisibility is a construction-time check too
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            TransformerConfig(num_heads=12, num_kv_heads=5)
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            TransformerConfig(num_heads=12, num_kv_heads=-1)
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            TransformerConfig(num_heads=12, num_kv_heads=24)
+
+    def test_bert_norm_override_is_uniform(self):
+        """norm='rmsnorm' on BertEncoder must apply to emb_ln/ln_f too, not
+        just the blocks (no silently mixed-norm encoder)."""
+        from tf_operator_tpu.models.transformer import BertEncoder, bert_base_config
+
+        cfg = bert_base_config(
+            num_layers=1, d_model=32, num_heads=2, d_ff=64, vocab_size=64,
+            max_len=16, dtype=jnp.float32, norm="rmsnorm")
+        toks = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, 64)
+        variables = BertEncoder(cfg).init(jax.random.PRNGKey(1), toks)
+        for name in ("emb_ln", "ln_f"):
+            # RMSNorm has scale only; a LayerNorm here would carry bias.
+            assert set(variables["params"][name]) == {"scale"}, name

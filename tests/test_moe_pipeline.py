@@ -136,6 +136,27 @@ class TestPipelinedLM:
         ref = x32 @ jax.device_get(params["wte"]).T
         np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=1e-4)
 
+    def test_llama_config_is_uniform(self):
+        """A llama-style config must not produce a mixed architecture: no
+        learned positional table on top of RoPE, RMS final norm (no bias),
+        and the gpipe/1f1b schedules still agree."""
+        from tf_operator_tpu.models.transformer import llama_style_config
+
+        mesh = build_mesh({"pp": 2, "dp": 4})
+        cfg = llama_style_config(
+            vocab_size=64, num_layers=4, num_heads=2, num_kv_heads=1,
+            d_model=16, d_ff=32, max_len=16, dtype=jnp.float32)
+        model = PipelinedTransformerLM(cfg, mesh, num_microbatches=2)
+        params = model.init(jax.random.PRNGKey(0))
+        assert "wpe" not in params and "ln_f_bias" not in params
+        params = model.shard_params(params)
+        tokens = jnp.arange(4 * 16, dtype=jnp.int32).reshape(4, 16) % 64
+        loss_g = float(jax.jit(model.loss_gpipe)(params, tokens))
+        loss_1 = float(
+            jax.jit(jax.value_and_grad(model.loss_1f1b))(params, tokens)[0])
+        assert np.isfinite(loss_g)
+        np.testing.assert_allclose(loss_g, loss_1, rtol=1e-5)
+
     def test_layers_must_divide_stages(self):
         mesh = build_mesh({"pp": 4, "dp": 2})
         cfg = TransformerConfig(num_layers=3, d_model=16, num_heads=2, d_ff=32,

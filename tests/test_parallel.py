@@ -62,6 +62,8 @@ class TestTPRules:
         assert combined_spec("block_0/attn/query/kernel", (64, 8, 8), mesh) == P(None, "tp")
         assert combined_spec("block_0/attn/out/kernel", (8, 8, 64), mesh) == P("tp")
         assert combined_spec("block_0/mlp/wi/kernel", (64, 256), mesh) == P(None, "tp")
+        # SwiGLU gate pairs with wi (column-parallel), not replicated
+        assert combined_spec("block_0/mlp/wg/kernel", (64, 256), mesh) == P(None, "tp")
         assert combined_spec("block_0/mlp/wo/kernel", (256, 64), mesh) == P("tp")
         assert combined_spec("wte/embedding", (32000, 64), mesh) == P("tp")
 
@@ -73,6 +75,19 @@ class TestTPRules:
     def test_no_tp_axis_no_tp_sharding(self):
         mesh = build_mesh({"dp": 8})
         assert combined_spec("block_0/mlp/wi/kernel", (64, 256), mesh) == P()
+
+    def test_indivisible_dim_replicates(self):
+        """A matched dim the tp axis doesn't divide (1-head debug model under
+        tp=2) must replicate, not produce an invalid sharding."""
+        mesh = build_mesh({"dp": 2, "tp": 4})
+        # attn bias [heads=1, head_dim]: rule wants dim 0, 1 % 4 != 0
+        assert combined_spec("block_0/attn/query/bias", (1, 64), mesh) == P()
+        # kernel [d_model, heads=2, head_dim]: rule wants dim 1, 2 % 4 != 0
+        assert combined_spec(
+            "block_0/attn/query/kernel", (64, 2, 32), mesh) == P()
+        # ep likewise: 3 experts don't shard over ep=2
+        mesh_ep = build_mesh({"dp": 4, "ep": 2})
+        assert combined_spec("block_0/moe/wi", (3, 64, 128), mesh_ep) == P()
 
     def test_make_param_shardings_tree(self):
         mesh = build_mesh({"dp": 4, "tp": 2})

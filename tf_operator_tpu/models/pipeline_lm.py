@@ -51,11 +51,17 @@ class PipelinedTransformerLM:
         stages = jax.tree_util.tree_map(stack, *layer_params)
         params = {
             "wte": jax.random.normal(keys[-1], (cfg.vocab_size, cfg.d_model)) * 0.02,
-            "wpe": jax.random.normal(keys[-2], (cfg.max_len, cfg.d_model)) * 0.02,
             "ln_f_scale": jnp.ones((cfg.d_model,)),
-            "ln_f_bias": jnp.zeros((cfg.d_model,)),
             "stages": stages,
         }
+        # Llama-family configs position via RoPE inside the blocks and
+        # normalize with RMSNorm — no positional table, no norm bias.
+        if not cfg.use_rope:
+            params["wpe"] = (
+                jax.random.normal(keys[-2], (cfg.max_len, cfg.d_model)) * 0.02
+            )
+        if cfg.norm == "layernorm":
+            params["ln_f_bias"] = jnp.zeros((cfg.d_model,))
         return params
 
     def shard_params(self, params):
@@ -91,10 +97,15 @@ class PipelinedTransformerLM:
         the gpipe==1f1b equivalence contract depends on that."""
         cfg = self.cfg
         x32 = act.astype(jnp.float32)
-        mean = x32.mean(-1, keepdims=True)
-        var = x32.var(-1, keepdims=True)
-        x32 = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
-        x32 = x32 * hp["ln_f_scale"] + hp["ln_f_bias"]
+        if cfg.norm == "rmsnorm":
+            x32 = x32 * jax.lax.rsqrt(
+                (x32 * x32).mean(-1, keepdims=True) + 1e-6
+            ) * hp["ln_f_scale"]
+        else:
+            mean = x32.mean(-1, keepdims=True)
+            var = x32.var(-1, keepdims=True)
+            x32 = (x32 - mean) * jax.lax.rsqrt(var + 1e-5)
+            x32 = x32 * hp["ln_f_scale"] + hp["ln_f_bias"]
         logits = x32.astype(cfg.dtype) @ hp["wte"].astype(cfg.dtype).T
         return logits.astype(jnp.float32)
 
@@ -106,11 +117,14 @@ class PipelinedTransformerLM:
         )[..., 0]
         return -jnp.mean(ll)
 
+    def _embed(self, params, tokens: jax.Array) -> jax.Array:
+        x = params["wte"][tokens]
+        if "wpe" in params:  # absent for RoPE configs
+            x = x + params["wpe"][None, : tokens.shape[1], :]
+        return x.astype(self.cfg.dtype)
+
     def apply(self, params, tokens: jax.Array) -> jax.Array:
-        cfg = self.cfg
-        b, t = tokens.shape
-        x = params["wte"][tokens] + params["wpe"][None, :t, :]
-        x = x.astype(cfg.dtype)
+        x = self._embed(params, tokens)
         x = gpipe(
             self._stage_fn, params["stages"], x, self.mesh,
             self.num_microbatches, axis=self.pp_axis,
@@ -135,14 +149,11 @@ class PipelinedTransformerLM:
         """Next-token loss through the fused 1F1B schedule (O(P) live
         microbatch residuals; see parallel/pipeline.one_f_one_b).  Same
         math as loss_gpipe — the schedules must agree to float tolerance."""
-        cfg = self.cfg
-        b, t = tokens.shape
-        x = params["wte"][tokens] + params["wpe"][None, :t, :]
-        x = x.astype(cfg.dtype)
+        x = self._embed(params, tokens)
         head = {
-            "wte": params["wte"],
-            "ln_f_scale": params["ln_f_scale"],
-            "ln_f_bias": params["ln_f_bias"],
+            k: params[k]
+            for k in ("wte", "ln_f_scale", "ln_f_bias")
+            if k in params
         }
         return one_f_one_b(
             self._stage_fn, self._head_loss_fn(), params["stages"], head,

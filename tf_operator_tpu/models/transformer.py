@@ -62,6 +62,25 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
 
+    def __post_init__(self):
+        # A typo'd knob must not silently train the default architecture.
+        if self.norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"norm must be 'layernorm'|'rmsnorm', got {self.norm!r}")
+        if self.mlp not in ("gelu", "swiglu"):
+            raise ValueError(f"mlp must be 'gelu'|'swiglu', got {self.mlp!r}")
+        if self.use_rope and (self.d_model // self.num_heads) % 2:
+            raise ValueError(
+                f"rope needs an even head_dim; d_model {self.d_model} / "
+                f"num_heads {self.num_heads} = {self.d_model // self.num_heads}"
+            )
+        if self.num_kv_heads < 0 or self.num_kv_heads > self.num_heads or (
+            self.num_kv_heads and self.num_heads % self.num_kv_heads
+        ):
+            raise ValueError(
+                f"num_kv_heads {self.num_kv_heads} must be in [0, num_heads] "
+                f"and divide num_heads {self.num_heads}"
+            )
+
 
 def rope(x, *, theta: float = 10000.0, positions=None):
     """Rotary position embeddings on [B, H, T, D] (D even): rotate feature
@@ -103,10 +122,8 @@ class SelfAttention(nn.Module):
     def __call__(self, x, mask=None):
         cfg = self.cfg
         head_dim = cfg.d_model // cfg.num_heads
+        # divisibility/range validated at config construction (__post_init__)
         kv_heads = cfg.num_kv_heads or cfg.num_heads
-        if cfg.num_heads % kv_heads:
-            raise ValueError(
-                f"num_heads {cfg.num_heads} must divide by num_kv_heads {kv_heads}")
 
         def dense(name, heads):
             return nn.DenseGeneral(
@@ -241,10 +258,10 @@ class BertEncoder(nn.Module):
             + self.param("pos_emb", nn.initializers.normal(0.02),
                          (cfg.max_len, cfg.d_model))[None, :t, :]
         )
-        x = nn.LayerNorm(dtype=jnp.float32, name="emb_ln")(x).astype(cfg.dtype)
+        x = _norm(cfg, "emb_ln")(x).astype(cfg.dtype)
         for i in range(cfg.num_layers):
             x = Block(cfg, name=f"block_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = _norm(cfg, "ln_f")(x)
         cls = jnp.tanh(nn.Dense(cfg.d_model, dtype=jnp.float32, name="pooler")(x[:, 0]))
         return {
             "sequence_output": x,

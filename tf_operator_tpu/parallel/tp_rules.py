@@ -30,9 +30,9 @@ _TP_RULES: Tuple[Tuple[str, dict], ...] = (
     # out projection kernel (heads, head_dim, d_model): shard input heads
     (r"attn/out/kernel$", {"shard_dim": 0}),
     (r"attn/out/bias$", {"shard_dim": None}),
-    # MLP: wi column-parallel, wo row-parallel
-    (r"mlp/wi/kernel$", {"shard_dim": 1}),
-    (r"mlp/wi/bias$", {"shard_dim": 0}),
+    # MLP: wi (and the SwiGLU gate wg) column-parallel, wo row-parallel
+    (r"mlp/(wi|wg)/kernel$", {"shard_dim": 1}),
+    (r"mlp/(wi|wg)/bias$", {"shard_dim": 0}),
     (r"mlp/wo/kernel$", {"shard_dim": 0}),
     (r"mlp/wo/bias$", {"shard_dim": None}),
     # embeddings: vocab-sharded
@@ -46,15 +46,19 @@ _EP_RULES: Tuple[Tuple[str, int], ...] = (
 )
 
 
-def tp_spec_for_path(path: str, ndim: int, mesh: Mesh) -> Optional[P]:
+def tp_spec_for_path(path: str, shape, mesh: Mesh) -> Optional[P]:
     """The tensor-parallel PartitionSpec for a param path, or None if no
-    rule matches / tp axis absent."""
-    if axis_size(mesh, AXIS_TP) <= 1:
+    rule matches / tp axis absent.  A matched dim that the tp axis doesn't
+    divide (e.g. a 1-head debug model under tp=2) replicates instead of
+    producing an invalid sharding."""
+    tp = axis_size(mesh, AXIS_TP)
+    if tp <= 1:
         return None
+    ndim = len(shape)
     for pattern, rule in _TP_RULES:
         if re.search(pattern, path):
             dim = rule["shard_dim"]
-            if dim is None or dim >= ndim:
+            if dim is None or dim >= ndim or shape[dim] % tp:
                 return P()
             spec = [None] * ndim
             spec[dim] = AXIS_TP
@@ -62,15 +66,17 @@ def tp_spec_for_path(path: str, ndim: int, mesh: Mesh) -> Optional[P]:
     return None
 
 
-def ep_spec_for_path(path: str, ndim: int, mesh: Mesh) -> Optional[P]:
+def ep_spec_for_path(path: str, shape, mesh: Mesh) -> Optional[P]:
     from .mesh import AXIS_EP
 
-    if axis_size(mesh, AXIS_EP) <= 1:
+    ep = axis_size(mesh, AXIS_EP)
+    if ep <= 1:
         return None
+    ndim = len(shape)
     for pattern, dim in _EP_RULES:
         if re.search(pattern, path):
             spec = [None] * ndim
-            if dim < ndim:
+            if dim < ndim and shape[dim] % ep == 0:
                 spec[dim] = AXIS_EP
             return P(*spec)
     return None
@@ -79,9 +85,9 @@ def ep_spec_for_path(path: str, ndim: int, mesh: Mesh) -> Optional[P]:
 def combined_spec(path: str, shape, mesh: Mesh) -> P:
     """EP/TP rule first; then FSDP-shard the largest remaining divisible dim."""
     ndim = len(shape)
-    spec = ep_spec_for_path(path, ndim, mesh)
+    spec = ep_spec_for_path(path, shape, mesh)
     if spec is None:
-        spec = tp_spec_for_path(path, ndim, mesh)
+        spec = tp_spec_for_path(path, shape, mesh)
     parts = list(spec) if spec is not None else [None] * ndim
     while len(parts) < ndim:
         parts.append(None)

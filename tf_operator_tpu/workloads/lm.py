@@ -67,11 +67,25 @@ def main(argv=None) -> int:
     if args.arch == "llama":
         if args.kv_heads:
             kv = args.kv_heads
-            # explicit input is honored or rejected, never silently changed
-            if heads % kv or kv % tp:
-                print(f"--kv-heads {kv} must divide num_heads {heads} and "
-                      f"be divisible by tp={tp}", flush=True)
+            # explicit input is honored or rejected, never silently changed;
+            # the kv%tp sharding constraint only binds when heads shard at
+            # all (heads % tp == 0) — otherwise projections replicate anyway
+            if kv <= 0 or heads % kv or (heads % tp == 0 and kv % tp):
+                print(f"--kv-heads {kv} must be positive, divide num_heads "
+                      f"{heads}, and be divisible by tp={tp}", flush=True)
                 return 2
+            if heads % tp:
+                print(f"warning: num_heads {heads} not divisible by tp={tp}; "
+                      f"attention projections will replicate", flush=True)
+        elif heads % tp:
+            # heads don't shard over tp at all (projections replicate via
+            # the tp_rules divisibility fallback) — kv % tp is moot, so
+            # just derive a divisor of heads near heads//3
+            kv = max(1, heads // 3)
+            while heads % kv:
+                kv -= 1
+            print(f"warning: num_heads {heads} not divisible by tp={tp}; "
+                  f"attention projections will replicate", flush=True)
         else:
             kv = max(1, heads // 3)
             # derived default: largest kv <= heads//3 that divides heads
@@ -79,19 +93,26 @@ def main(argv=None) -> int:
             while kv > 1 and (heads % kv or kv % tp):
                 kv -= 1
             if heads % kv or kv % tp:
-                kv = heads  # degenerate fall-back: plain MHA
+                # tp divides heads here, so kv=tp always satisfies both
+                kv = tp
         extra = dict(num_kv_heads=kv, use_rope=True, norm="rmsnorm",
                      mlp="swiglu")
         # SwiGLU has 3 matrices; 8/3 scaling keeps MLP params comparable
         # to the 2-matrix GELU MLP at 4*d_model
         d_ff = args.d_model * 8 // 3
-    cfg = TransformerConfig(
-        vocab_size=args.vocab, num_layers=args.layers,
-        num_heads=heads, d_model=args.d_model,
-        d_ff=d_ff, max_len=args.seq_len,
-        mesh=mesh, ring_axis="sp", remat=args.remat,
-        moe_num_experts=args.moe_experts, **extra,
-    )
+    try:
+        cfg = TransformerConfig(
+            vocab_size=args.vocab, num_layers=args.layers,
+            num_heads=heads, d_model=args.d_model,
+            d_ff=d_ff, max_len=args.seq_len,
+            mesh=mesh, ring_axis="sp", remat=args.remat,
+            moe_num_experts=args.moe_experts, **extra,
+        )
+    except ValueError as e:
+        # e.g. --arch llama with an odd derived head_dim: a CLI-input
+        # problem, reported like one (not a traceback)
+        print(f"invalid model config: {e}", flush=True)
+        return 2
     model = TransformerLM(cfg)
     state = create_train_state(
         jax.random.PRNGKey(0), model, optax.adamw(args.lr),
