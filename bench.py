@@ -140,6 +140,20 @@ def _probe_backend(stages):
     return None
 
 
+def _backend_alive(stages, tag: str) -> bool:
+    """One-shot liveness re-check between TPU stages.  The tunneled backend
+    can wedge mid-run (observed: `import jax` itself hangs once the tunnel
+    dies), after which every child burns its full timeout learning nothing —
+    a dead tunnel must cost one short probe, not 20 minutes per stage."""
+    t0 = time.time()
+    rc, out, _ = _run([sys.executable, "-c", _PROBE_SRC], {},
+                      float(os.environ.get("BENCH_REPROBE_TIMEOUT", "90")))
+    alive = any(line.startswith("PROBE_OK") for line in out.splitlines())
+    stages.append({"stage": f"reprobe:{tag}", "ok": alive,
+                   "sec": round(time.time() - t0, 1)})
+    return alive
+
+
 def _cpu_fallback_env():
     """FIXED small shapes so compile+run stay in budget on CPU — deliberately
     ignoring any TPU-sized BENCH_* the user exported (override with
@@ -188,6 +202,11 @@ def _throughput(platform, stages, model):
         if parsed is not None:
             parsed["platform"] = platform or "cpu"
             return parsed
+        if platform is not None and rc == -9 and not _backend_alive(
+                stages, f"throughput:{model}"):
+            # Timed out AND the backend no longer answers: the rest of the
+            # ladder would hang the same way.  Stop here.
+            return None
     return None
 
 
@@ -264,17 +283,38 @@ def orchestrate() -> None:
     stages = []
     results = {}
     platform = None
+    # Liveness re-checks only run once a TPU stage has actually failed
+    # (tpu_suspect) — a stage that just succeeded proves the backend alive,
+    # and skipped stages shouldn't pay a probe at all.
+    def tpu_dead(tag: str) -> bool:
+        return (platform is not None and tpu_suspect
+                and not _backend_alive(stages, tag))
+
+    tpu_suspect = False
     try:
         platform = _probe_backend(stages)
         results[MODEL] = _throughput(platform, stages, MODEL)
+        tpu_suspect = platform is not None and results[MODEL] is None
         other = "lm" if MODEL == "resnet" else "resnet"
         if not os.environ.get("BENCH_SKIP_SECOND_MODEL"):
-            results[other] = _throughput(platform, stages, other)
+            if tpu_dead(f"throughput:{other}"):
+                stages.append({"stage": f"throughput:{other}",
+                               "skipped": "backend unreachable"})
+            else:
+                results[other] = _throughput(platform, stages, other)
+                if platform is not None and results[other] is None:
+                    tpu_suspect = True
     except Exception as e:  # noqa: BLE001 — the one JSON line must still print
         stages.append({"stage": "orchestrator", "err": repr(e)[:300]})
     attention = None
     try:
-        attention = _attention_ladder(platform, stages)
+        if os.environ.get("BENCH_SKIP_ATTENTION"):
+            pass
+        elif tpu_dead("attention"):
+            stages.append({"stage": "attention",
+                           "skipped": "backend unreachable"})
+        else:
+            attention = _attention_ladder(platform, stages)
     except Exception as e:  # noqa: BLE001
         stages.append({"stage": "attention", "err": repr(e)[:300]})
     cp = native = None
@@ -528,7 +568,7 @@ def child_attention() -> None:
     import jax.numpy as jnp
 
     from tf_operator_tpu.ops.attention import (
-        _on_tpu, flash_attention, xla_attention,
+        _on_tpu, _repeat_kv, flash_attention, xla_attention,
     )
 
     seqs = [int(s) for s in os.environ.get(
@@ -536,12 +576,18 @@ def child_attention() -> None:
     b, h, d = (int(os.environ.get(k, v)) for k, v in
                (("BENCH_ATTN_B", "4"), ("BENCH_ATTN_H", "12"),
                 ("BENCH_ATTN_D", "64")))
+    # kv heads < h exercises the GQA-native kernel path (k/v mapped to
+    # query groups in-kernel); the XLA arm widens k/v explicitly, so the
+    # speedup row also prices the avoided repeat traffic.
+    kv_h = int(os.environ.get("BENCH_ATTN_KV_H", str(h)))
     reps = int(os.environ.get("BENCH_ATTN_REPS", "5"))
     rows = []
     for t in seqs:
         key = jax.random.PRNGKey(0)
-        q, k, v = (jax.random.normal(kk, (b, h, t, d)).astype(jnp.bfloat16)
-                   for kk in jax.random.split(key, 3))
+        kq, kk_, kv_ = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, h, t, d)).astype(jnp.bfloat16)
+        k = jax.random.normal(kk_, (b, kv_h, t, d)).astype(jnp.bfloat16)
+        v = jax.random.normal(kv_, (b, kv_h, t, d)).astype(jnp.bfloat16)
         g = jnp.ones((b, h, t, d), jnp.bfloat16)
 
         def timed(fn):
@@ -561,6 +607,12 @@ def child_attention() -> None:
         # OOM where the flash kernel runs fine — that asymmetry IS the
         # result, so an XLA failure must not discard the flash number.
         row = {"seq": t}
+        if kv_h != h:
+            row["kv_heads"] = kv_h
+
+        def widened_xla(q, k, v):
+            return xla_attention(q, *_repeat_kv(q, k, v), causal=True)
+
         flash_s = xla_s = None
         try:
             flash_s = timed(lambda q, k, v: flash_attention(q, k, v, True))
@@ -568,7 +620,7 @@ def child_attention() -> None:
         except Exception as e:  # noqa: BLE001
             row["flash_error"] = repr(e)[:200]
         try:
-            xla_s = timed(lambda q, k, v: xla_attention(q, k, v, causal=True))
+            xla_s = timed(widened_xla)
             row["xla_ms"] = round(xla_s * 1e3, 3)
         except Exception as e:  # noqa: BLE001 — e.g. OOM on the O(T²) path
             row["xla_error"] = repr(e)[:200]

@@ -65,6 +65,42 @@ def test_flash_backward_kernel_matches_xla_vjp(causal, t, bq, bk):
     np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_ref), atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("t", [128, 100])
+def test_flash_gqa_kernels_match_repeated_reference(causal, t):
+    """GQA-native kernels (k/v at kv_heads < heads, mapped via index maps)
+    against the repeat-outside reference: same output; dk/dv equal to the
+    widened-MHA grads summed back over each query group."""
+    h, kv_h, group, d = 4, 2, 2, 16
+    q, _, _ = qkv(t, d=d, b=2, h=h)
+    keys = jax.random.split(jax.random.PRNGKey(5), 2)
+    k = jax.random.normal(keys[0], (2, kv_h, t, d))
+    v = jax.random.normal(keys[1], (2, kv_h, t, d))
+    g = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+    out, dq, dk, dv = flash_attention_grads_interpret(
+        q, k, v, g, causal, None, 64, 64)
+    assert dk.shape == k.shape and dv.shape == v.shape
+
+    kw, vw = (jnp.repeat(x, group, axis=1) for x in (k, v))
+    ref, vjp = jax.vjp(
+        lambda q, k, v: xla_attention(q, k, v, causal=causal), q, kw, vw)
+    dq_ref, dkw, dvw = vjp(g)
+    # widened grads fold back: sum over each kv head's query group
+    dk_ref = dkw.reshape(2, kv_h, group, t, d).sum(2)
+    dv_ref = dvw.reshape(2, kv_h, group, t, d).sum(2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_ref), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dk), np.asarray(dk_ref), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_ref), atol=1e-4)
+
+
+def test_flash_gqa_rejects_indivisible_heads():
+    q, k, v = qkv(64, d=16, h=3)
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        flash_attention(q, k[:, :2], v[:, :2])
+
+
 def test_flash_backward_bf16_inputs():
     """bf16 q/k/v (the documented MXU layout): kernels accumulate in f32 and
     cast outputs back; agreement with the f32 reference within bf16 noise."""
@@ -144,6 +180,44 @@ class TestCompiledOnTPU:
                 np.asarray(got, np.float32), np.asarray(want, np.float32),
                 atol=0.1, rtol=0.1,
             )
+
+    @pytest.mark.parametrize("t", [256, 300])
+    def test_gqa_compiled(self, t):
+        """Compiled GQA path (kv heads mapped in-kernel, never repeated in
+        HBM): fwd + dq/dk/dv vs the widened f32 reference."""
+        h, kv_h, group, d = 4, 2, 2, 64
+        q, _, _ = qkv(t, d=d, h=h, dtype=jnp.bfloat16)
+        keys = jax.random.split(jax.random.PRNGKey(5), 2)
+        k = jax.random.normal(keys[0], (2, kv_h, t, d)).astype(jnp.bfloat16)
+        v = jax.random.normal(keys[1], (2, kv_h, t, d)).astype(jnp.bfloat16)
+
+        out = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))(q, k, v)
+
+        def widened(q32, k32, v32):
+            return xla_attention(
+                q32, jnp.repeat(k32, group, axis=1),
+                jnp.repeat(v32, group, axis=1), causal=True)
+
+        qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(widened(qf, kf, vf)),
+            atol=0.05, rtol=0.05)
+
+        def loss(attn, *args):
+            return jnp.sum(attn(*args).astype(jnp.float32) ** 2)
+
+        grads = jax.jit(jax.grad(
+            lambda q, k, v: loss(
+                lambda *a: flash_attention(*a, True), q, k, v),
+            argnums=(0, 1, 2)))(q, k, v)
+        refs = jax.jit(jax.grad(
+            lambda q, k, v: loss(widened, q, k, v),
+            argnums=(0, 1, 2)))(qf, kf, vf)
+        for got, want in zip(grads, refs):
+            assert got.shape == want.shape
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32), np.asarray(want, np.float32),
+                atol=0.1, rtol=0.1)
 
 
 class TestFlashAttentionLse:

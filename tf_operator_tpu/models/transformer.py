@@ -139,13 +139,10 @@ class SelfAttention(nn.Module):
         if cfg.use_rope:
             q = rope(q, theta=cfg.rope_theta)
             k = rope(k, theta=cfg.rope_theta)
-        if kv_heads != cfg.num_heads:
-            # GQA: repeat each K/V head across its query group OUTSIDE the
-            # attention op — autodiff of the repeat sums dk/dv back over the
-            # group, so the kernels stay head-count agnostic.
-            group = cfg.num_heads // kv_heads
-            k = jnp.repeat(k, group, axis=1)
-            v = jnp.repeat(v, group, axis=1)
+        # The flash and ring paths consume grouped k/v natively (no repeat
+        # in HBM; ops/attention.py maps query heads to KV heads in-kernel,
+        # and ring hops move the grouped blocks over ICI).  Only the plain
+        # XLA path needs the explicit widen.
         if _use_ring(cfg):
             out = ring_attention(
                 q, k, v, cfg.mesh, axis_name=cfg.ring_axis, causal=cfg.causal
@@ -153,6 +150,10 @@ class SelfAttention(nn.Module):
         elif cfg.use_flash:
             out = flash_attention(q, k, v, cfg.causal)
         else:
+            if kv_heads != cfg.num_heads:
+                group = cfg.num_heads // kv_heads
+                k = jnp.repeat(k, group, axis=1)
+                v = jnp.repeat(v, group, axis=1)
             out = xla_attention(q, k, v, causal=cfg.causal)
         out = out.transpose(0, 2, 1, 3)  # [B, T, H, D]
         return nn.DenseGeneral(

@@ -40,6 +40,13 @@ Sequence lengths that don't divide the block size are zero-padded to the
 next block boundary; padded key positions are masked with -inf inside the
 kernels and padded query rows are sliced off, so any seq_len works.
 
+Grouped-query attention is native: k/v may carry fewer heads than q
+(heads % kv_heads == 0) and the kernels map each query head to its KV head
+through the BlockSpec index maps — k/v are never repeated in HBM, and
+dk/dv accumulate over the whole query group inside the dk/dv kernel (its
+innermost grid dim runs group × q-blocks), so the fwd+bwd K/V traffic is
+1/group of the repeat-outside approach the pure-XLA fallback uses.
+
 Sequence-parallel long-context attention lives in parallel/ring_attention.py
 and composes with this kernel per-shard.
 """
@@ -183,13 +190,19 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
     grid, compacted to one lane outside the kernel (the kernel emits the
     Mosaic-legal lane-replicated tile; carrying the residual at [bh, Tp]
     keeps fwd→bwd HBM at 1/LANE of the tile form). With save_lse=False the
-    lse output is omitted entirely (primal-only path writes nothing)."""
+    lse output is omitted entirely (primal-only path writes nothing).
+
+    GQA: k/v may have kv_heads < heads; each query head reads kv head
+    h // group through the k/v index maps (flattened: kv index b // group,
+    exact because b = bi*H + h and H = Hkv*group)."""
     batch, heads, real_len, head_dim = q.shape
+    kv_heads = k.shape[1]
+    group = heads // kv_heads
     block_q = min(block_q, max(real_len, 1))
     block_k = min(block_k, max(real_len, 1))
     qf = _pad_seq(q.reshape(batch * heads, real_len, head_dim), block_q)
-    kf = _pad_seq(k.reshape(batch * heads, real_len, head_dim), block_k)
-    vf = _pad_seq(v.reshape(batch * heads, real_len, head_dim), block_k)
+    kf = _pad_seq(k.reshape(batch * kv_heads, real_len, head_dim), block_k)
+    vf = _pad_seq(v.reshape(batch * kv_heads, real_len, head_dim), block_k)
     # one padded length for both axes so the kernel's seq_len is square
     seq_len = max(qf.shape[1], kf.shape[1])
     qf = _pad_seq(qf, seq_len)
@@ -217,14 +230,17 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
         pltpu.VMEM((block_q, LANE), jnp.float32),       # l
         pltpu.VMEM((block_q, head_dim), jnp.float32),   # acc
     ]
+    kvspec = pl.BlockSpec(
+        (1, block_k, head_dim), lambda b, i, j: (b // group, j, 0)
+    )
     res = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shape),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0)),
+            kvspec,
+            kvspec,
         ],
         out_specs=tuple(out_specs),
         scratch_shapes=scratch,
@@ -299,11 +315,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
                     causal: bool, block_q: int, block_k: int, num_qb: int,
-                    real_len: int, seq_len: int):
+                    group: int, real_len: int, seq_len: int):
+    # Innermost grid dim fuses (group member, q-block) group-major: dk/dv
+    # for a KV head accumulate over every q-block of every query head in
+    # its group before the single write-out.
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    j = pl.program_id(2)
+    qi = j % num_qb
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -354,7 +374,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         _compute()
 
-    @pl.when(qi == num_qb - 1)
+    @pl.when(j == num_qb * group - 1)
     def _write():
         dk_ref[0] = (dk_scr[...] * scale).astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -369,12 +389,16 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     ds = p·(dp − delta + dlse) = p·(dp − (delta − dlse)), since
     ∂lse_i/∂s_ij = p_ij — so the kernels just receive delta' = delta − dlse."""
     batch, heads, real_len, head_dim = q.shape
+    kv_heads = k.shape[1]
+    group = heads // kv_heads
     block_q = min(block_q, max(real_len, 1))
     block_k = min(block_k, max(real_len, 1))
     bh = batch * heads
 
     def flat(x, block):
-        return _pad_seq(x.reshape(bh, real_len, head_dim), block)
+        return _pad_seq(
+            x.reshape(batch * x.shape[1], real_len, head_dim), block
+        )
 
     qf = flat(q, block_q)
     kf = flat(k, block_k)
@@ -405,9 +429,12 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     num_kb = seq_len // block_k
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, real_len=real_len, seq_len=seq_len)
-    # dq pass: grid (bh, q-block, k-block), K innermost (reduction)
+    # dq pass: grid (bh, q-block, k-block), K innermost (reduction);
+    # GQA maps each query head to its KV head, as in the forward
     qspec = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0))
-    kspec_j = pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, j, 0))
+    kspec_j = pl.BlockSpec(
+        (1, block_k, head_dim), lambda b, i, j: (b // group, j, 0)
+    )
     rowspec_q = pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
@@ -421,17 +448,24 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
         **_compiler_params(interpret, ("parallel", "parallel", "arbitrary")),
     )(qf, kf, vf, dof, lse, delta)
 
-    # dk/dv pass: grid (bh, k-block, q-block), Q innermost (reduction)
-    qspec_j = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, j, 0))
+    # dk/dv pass: grid (B*Hkv, k-block, group×q-block), Q innermost
+    # (reduction over every q-block of every query head in the group).
+    # From kv index b: q flat index = (b//Hkv)*H + (b%Hkv)*group + member.
+    def q_side(b, i, j):
+        return ((b // kv_heads) * heads + (b % kv_heads) * group + j // num_qb,
+                j % num_qb, 0)
+
+    qspec_j = pl.BlockSpec((1, block_q, head_dim), q_side)
     kspec_i = pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, i, 0))
-    rowspec_j = pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, j, 0))
+    rowspec_j = pl.BlockSpec((1, block_q, LANE), q_side)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, num_qb=num_qb, **common),
+        functools.partial(_bwd_dkv_kernel, num_qb=num_qb, group=group,
+                          **common),
         out_shape=(
             jax.ShapeDtypeStruct(kf.shape, k.dtype),
             jax.ShapeDtypeStruct(vf.shape, v.dtype),
         ),
-        grid=(bh, num_kb, num_qb),
+        grid=(batch * kv_heads, num_kb, num_qb * group),
         in_specs=[qspec_j, kspec_i, kspec_i, qspec_j, rowspec_j, rowspec_j],
         out_specs=(kspec_i, kspec_i),
         scratch_shapes=[
@@ -442,10 +476,10 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
         **_compiler_params(interpret, ("parallel", "parallel", "arbitrary")),
     )(qf, kf, vf, dof, lse, delta)
 
-    def unflat(x):
-        return x[:, :real_len, :].reshape(batch, heads, real_len, head_dim)
+    def unflat(x, h):
+        return x[:, :real_len, :].reshape(batch, h, real_len, head_dim)
 
-    return unflat(dq), unflat(dk), unflat(dv)
+    return unflat(dq, heads), unflat(dk, kv_heads), unflat(dv, kv_heads)
 
 
 # ---------------------------------------------------------------------------
@@ -457,6 +491,22 @@ def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None
     return xla_attention_lse(q, k, v, causal=causal, scale=scale)[0]
 
 
+def _repeat_kv(q, k, v):
+    """Widen GQA k/v to q's head count (the repeat-in-HBM fallback the
+    Pallas kernels avoid via index maps)."""
+    group = q.shape[1] // k.shape[1]
+    if group == 1:
+        return k, v
+    return jnp.repeat(k, group, axis=1), jnp.repeat(v, group, axis=1)
+
+
+def _check_gqa(q, k):
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"q heads {q.shape[1]} must be a multiple of kv heads {k.shape[1]}"
+        )
+
+
 def _on_tpu() -> bool:
     try:
         return jax.default_backend() in ("tpu", "axon")
@@ -466,22 +516,26 @@ def _on_tpu() -> bool:
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(q, k, v, causal=True, scale=None, block_q=128, block_k=128):
-    """Fused attention; Pallas kernels (fwd + bwd) on TPU, XLA elsewhere."""
+    """Fused attention; Pallas kernels (fwd + bwd) on TPU, XLA elsewhere.
+    k/v may carry fewer (grouped-query) heads than q — the kernels never
+    repeat them in HBM; the XLA fallback widens them explicitly."""
+    _check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     if _on_tpu():
         out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k,
                                 interpret=False, save_lse=False)
         return out
-    return xla_attention(q, k, v, causal=causal, scale=s)
+    return xla_attention(q, *_repeat_kv(q, k, v), causal=causal, scale=s)
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
+    _check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     if _on_tpu():
         out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
                                   interpret=False)
         return out, (q, k, v, out, lse)
-    out = xla_attention(q, k, v, causal=causal, scale=s)
+    out = xla_attention(q, *_repeat_kv(q, k, v), causal=causal, scale=s)
     return out, (q, k, v, None, None)
 
 
@@ -492,7 +546,10 @@ def _bwd(causal, scale, block_q, block_k, res, g):
         return _flash_backward(q, k, v, o, lse, g, s, causal,
                                block_q, block_k, interpret=False)
     _, vjp = jax.vjp(
-        lambda q, k, v: xla_attention(q, k, v, causal=causal, scale=s), q, k, v
+        lambda q, k, v: xla_attention(
+            q, *_repeat_kv(q, k, v), causal=causal, scale=s
+        ),
+        q, k, v,
     )
     return vjp(g)
 
@@ -530,24 +587,27 @@ def flash_attention_lse(q, k, v, causal=True, scale=None,
                         block_q=128, block_k=128):
     """Fused attention returning (o, lse [B,H,T] f32); Pallas on TPU, XLA
     elsewhere.  Differentiable in BOTH outputs (the lse cotangent folds into
-    the backward's delta term — see _flash_backward)."""
+    the backward's delta term — see _flash_backward).  GQA k/v supported as
+    in flash_attention."""
+    _check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     if _on_tpu():
         batch, heads, t, _ = q.shape
         out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
                                   interpret=False)
         return out, lse[:, :t].reshape(batch, heads, t)
-    return xla_attention_lse(q, k, v, causal=causal, scale=s)
+    return xla_attention_lse(q, *_repeat_kv(q, k, v), causal=causal, scale=s)
 
 
 def _fwd_lse(q, k, v, causal, scale, block_q, block_k):
+    _check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     if _on_tpu():
         batch, heads, t, _ = q.shape
         out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
                                   interpret=False)
         return (out, lse[:, :t].reshape(batch, heads, t)), (q, k, v, out, lse)
-    out, lse = xla_attention_lse(q, k, v, causal=causal, scale=s)
+    out, lse = xla_attention_lse(q, *_repeat_kv(q, k, v), causal=causal, scale=s)
     return (out, lse), (q, k, v, None, None)
 
 
@@ -560,7 +620,9 @@ def _bwd_lse(causal, scale, block_q, block_k, res, gs):
                                block_q, block_k, interpret=False,
                                g_lse=g_lse)
     _, vjp = jax.vjp(
-        lambda q, k, v: xla_attention_lse(q, k, v, causal=causal, scale=s),
+        lambda q, k, v: xla_attention_lse(
+            q, *_repeat_kv(q, k, v), causal=causal, scale=s
+        ),
         q, k, v,
     )
     return vjp((g_o, g_lse))

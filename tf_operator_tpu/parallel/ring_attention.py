@@ -192,9 +192,22 @@ def ring_attention(
     size.  use_flash=True (default) runs the Pallas flash kernel per hop on
     TPU (falling back to closed-form XLA off-TPU inside the op);
     use_flash=False keeps the pure-einsum hop math.
+
+    Grouped-query attention: k/v may carry fewer heads than q.  On the
+    flash path the grouped blocks travel the ring as-is — each ppermute
+    hop moves 1/group of the MHA bytes over ICI and the kernel maps query
+    heads to KV heads in VMEM; the einsum path widens k/v up front.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"q heads {q.shape[1]} must be a multiple of kv heads {k.shape[1]}"
+        )
+    if not use_flash and k.shape[1] != q.shape[1]:
+        group = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
     local = _ring_attention_local_flash if use_flash else _ring_attention_local
     spec = P(None, None, axis_name, None)
     fn = shard_map(

@@ -70,9 +70,15 @@ def main(argv=None) -> int:
             # explicit input is honored or rejected, never silently changed;
             # the kv%tp sharding constraint only binds when heads shard at
             # all (heads % tp == 0) — otherwise projections replicate anyway
-            if kv <= 0 or heads % kv or (heads % tp == 0 and kv % tp):
-                print(f"--kv-heads {kv} must be positive, divide num_heads "
-                      f"{heads}, and be divisible by tp={tp}", flush=True)
+            problem = None
+            if kv <= 0:
+                problem = "must be positive"
+            elif heads % kv:
+                problem = f"must divide num_heads {heads}"
+            elif heads % tp == 0 and kv % tp:
+                problem = f"must be divisible by tp={tp}"
+            if problem:
+                print(f"--kv-heads {kv} {problem}", flush=True)
                 return 2
             if heads % tp:
                 print(f"warning: num_heads {heads} not divisible by tp={tp}; "
