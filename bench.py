@@ -302,8 +302,9 @@ def orchestrate() -> None:
                                "skipped": "backend unreachable"})
             else:
                 results[other] = _throughput(platform, stages, other)
-                if platform is not None and results[other] is None:
-                    tpu_suspect = True
+                if platform is not None:
+                    # this stage's outcome is the freshest liveness evidence
+                    tpu_suspect = results[other] is None
     except Exception as e:  # noqa: BLE001 — the one JSON line must still print
         stages.append({"stage": "orchestrator", "err": repr(e)[:300]})
     attention = None
@@ -426,13 +427,21 @@ def child_throughput() -> None:
         from tf_operator_tpu.train.step import lm_loss_fn
 
         seq = int(os.environ.get("BENCH_SEQ", "2048"))
+        # BENCH_LM_ARCH=llama measures the llama family (RoPE/RMSNorm/
+        # SwiGLU/GQA — the GQA-native kernel path) instead of GPT-style.
+        arch = {}
+        if os.environ.get("BENCH_LM_ARCH", "gpt") == "llama":
+            arch = dict(
+                use_rope=True, norm="rmsnorm", mlp="swiglu",
+                num_kv_heads=int(os.environ.get("BENCH_LM_KV_HEADS", "4")),
+            )
         cfg = TransformerConfig(
             vocab_size=int(os.environ.get("BENCH_LM_VOCAB", "32000")),
             num_layers=int(os.environ.get("BENCH_LM_LAYERS", "12")),
             num_heads=int(os.environ.get("BENCH_LM_HEADS", "12")),
             d_model=int(os.environ.get("BENCH_LM_DMODEL", "768")),
             d_ff=int(os.environ.get("BENCH_LM_DFF", "3072")),
-            max_len=seq, causal=True, dtype=jnp.bfloat16,
+            max_len=seq, causal=True, dtype=jnp.bfloat16, **arch,
         )
         model = TransformerLM(cfg)
         tokens = jnp.asarray(
@@ -468,7 +477,8 @@ def child_throughput() -> None:
 
         bare_state = (params, opt_state)
         unit, per_step = "tokens/sec", batch_size * seq
-        metric = f"lm_train_tokens_per_sec_bf16_b{batch_size}_t{seq}"
+        tag = "llama_" if arch else ""
+        metric = f"lm_{tag}train_tokens_per_sec_bf16_b{batch_size}_t{seq}"
 
         # Training FLOPs/token ~= 6P (dense matmuls fwd+bwd) + causal
         # attention term 6·L·d_model·T (12·L·d·T halved by the mask).

@@ -182,6 +182,22 @@ def test_lm_use_flash_false_matches_flash_path():
         np.asarray(out), np.asarray(out_xla), atol=1e-5)
 
 
+def test_profile_capture_writes_trace(tmp_path):
+    """--profile-dir on a workload captures a real jax.profiler trace
+    (TensorBoard/Perfetto-viewable) over the configured step window."""
+    from tf_operator_tpu.workloads import lm
+
+    rc = lm.main([
+        "--steps", "5", "--batch", "8", "--seq-len", "16", "--vocab", "64",
+        "--layers", "1", "--d-model", "32",
+        "--profile-dir", str(tmp_path), "--profile-start", "1",
+        "--profile-steps", "2",
+    ])
+    assert rc == 0
+    traces = list(tmp_path.rglob("*.xplane.pb"))
+    assert traces, f"no trace files under {tmp_path}"
+
+
 class TestModernLM:
     """Llama-family architecture knobs (RoPE, RMSNorm, SwiGLU, GQA) — the
     beyond-parity model family; the reference has no model zoo at all."""

@@ -150,11 +150,9 @@ class SelfAttention(nn.Module):
         elif cfg.use_flash:
             out = flash_attention(q, k, v, cfg.causal)
         else:
-            if kv_heads != cfg.num_heads:
-                group = cfg.num_heads // kv_heads
-                k = jnp.repeat(k, group, axis=1)
-                v = jnp.repeat(v, group, axis=1)
-            out = xla_attention(q, k, v, causal=cfg.causal)
+            from ..ops.attention import _repeat_kv
+
+            out = xla_attention(q, *_repeat_kv(q, k, v), causal=cfg.causal)
         out = out.transpose(0, 2, 1, 3)  # [B, T, H, D]
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out",

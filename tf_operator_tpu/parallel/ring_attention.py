@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import flash_attention_lse
+from ..ops.attention import _check_gqa, _repeat_kv, flash_attention_lse
 
 try:
     from jax import shard_map as _shard_map  # jax >= 0.8 (check_vma kwarg)
@@ -200,14 +200,9 @@ def ring_attention(
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    if q.shape[1] % k.shape[1]:
-        raise ValueError(
-            f"q heads {q.shape[1]} must be a multiple of kv heads {k.shape[1]}"
-        )
-    if not use_flash and k.shape[1] != q.shape[1]:
-        group = q.shape[1] // k.shape[1]
-        k = jnp.repeat(k, group, axis=1)
-        v = jnp.repeat(v, group, axis=1)
+    _check_gqa(q, k)
+    if not use_flash:
+        k, v = _repeat_kv(q, k, v)
     local = _ring_attention_local_flash if use_flash else _ring_attention_local
     spec = P(None, None, axis_name, None)
     fn = shard_map(

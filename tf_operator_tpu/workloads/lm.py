@@ -29,6 +29,10 @@ def main(argv=None) -> int:
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="enable MoE with this many experts (ep-sharded)")
     parser.add_argument("--moe-aux-weight", type=float, default=0.01)
+    parser.add_argument("--profile-dir", default=None,
+                        help="capture a jax.profiler trace here")
+    parser.add_argument("--profile-start", type=int, default=2)
+    parser.add_argument("--profile-steps", type=int, default=3)
     parser.add_argument("--arch", choices=("gpt", "llama"), default="gpt",
                         help="gpt: learned positions + LayerNorm + GELU; "
                              "llama: RoPE + RMSNorm + SwiGLU + GQA")
@@ -36,7 +40,7 @@ def main(argv=None) -> int:
                         help="GQA KV heads for --arch llama (0 = heads/3)")
     args = parser.parse_args(argv)
 
-    from .runner import WorkloadContext, apply_forced_platform
+    from .runner import ProfileCapture, WorkloadContext, apply_forced_platform
 
     apply_forced_platform()
 
@@ -141,12 +145,16 @@ def main(argv=None) -> int:
     ))
     data = synthetic_tokens(args.batch, args.seq_len + 1, args.vocab)
     start = int(state.step)
+    prof = ProfileCapture(args.profile_dir, start + args.profile_start,
+                          args.profile_steps)
     for i in range(start, args.steps):
+        prof.step(i)
         state, metrics = step(state, shard_batch(next(data), mesh))
         if i % 10 == 0:
             print(f"step {i} loss {float(metrics['loss']):.4f}", flush=True)
         if mgr is not None and (i + 1) % args.checkpoint_every == 0:
             mgr.save(state)
+    prof.close()
     if mgr is not None:
         mgr.save(state)
         mgr.close()

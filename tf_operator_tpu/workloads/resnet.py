@@ -23,9 +23,13 @@ def main(argv=None) -> int:
     parser.add_argument("--lr", type=float, default=0.1)
     parser.add_argument("--depth", type=int, default=50, choices=(18, 34, 50, 101, 152))
     parser.add_argument("--log-every", type=int, default=10)
+    parser.add_argument("--profile-dir", default=None,
+                        help="capture a jax.profiler trace here")
+    parser.add_argument("--profile-start", type=int, default=2)
+    parser.add_argument("--profile-steps", type=int, default=3)
     args = parser.parse_args(argv)
 
-    from .runner import WorkloadContext, apply_forced_platform
+    from .runner import ProfileCapture, WorkloadContext, apply_forced_platform
 
     apply_forced_platform()
 
@@ -63,13 +67,17 @@ def main(argv=None) -> int:
         has_batch_stats=True,
     )
     data = images_or_fallback(args.batch, args.image_size, args.num_classes)
+    prof = ProfileCapture(args.profile_dir, args.profile_start,
+                          args.profile_steps)
     t_start = time.time()
     for i in range(args.steps):
+        prof.step(i)
         batch = next(data)
         batch["x"] = batch["x"].astype("bfloat16")
         state, metrics = step(state, shard_batch(batch, mesh))
         if i % args.log_every == 0:
             print(f"step {i} loss {float(metrics['loss']):.4f}", flush=True)
+    prof.close()
     elapsed = time.time() - t_start
     print(f"done: {args.steps} steps, {args.steps * args.batch / elapsed:.1f} img/s",
           flush=True)

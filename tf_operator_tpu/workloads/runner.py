@@ -17,6 +17,50 @@ from typing import Dict, Optional
 from ..api import constants
 
 
+class ProfileCapture:
+    """Device-side profiling for a window of training steps.
+
+    Captures a jax.profiler trace (XLA ops, TPU timelines, host/device
+    overlap — viewable in TensorBoard's profile plugin or Perfetto) into
+    `profile_dir` between `start_step` and `start_step + num_steps`.  The
+    window starts after warmup by default so the compile doesn't drown the
+    steady-state trace.  No-op when profile_dir is falsy — workloads call
+    `step(i)` unconditionally.  The reference delegates profiling to the
+    user container entirely; here the runtime owns the hot loop, so it owns
+    the trace hook too (pprof analogue on the operator side is
+    server//debug/threads).
+    """
+
+    def __init__(self, profile_dir: Optional[str], start_step: int = 2,
+                 num_steps: int = 3) -> None:
+        # A non-positive window means "capture nothing", not "never stop".
+        self.profile_dir = profile_dir if num_steps > 0 else None
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._running = False
+
+    def step(self, i: int) -> None:
+        if not self.profile_dir:
+            return
+        import jax
+
+        if i == self.start_step and not self._running:
+            jax.profiler.start_trace(self.profile_dir)
+            self._running = True
+        elif i == self.stop_step and self._running:
+            jax.profiler.stop_trace()
+            self._running = False
+            print(f"profile trace written to {self.profile_dir}", flush=True)
+
+    def close(self) -> None:
+        if self._running:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._running = False
+            print(f"profile trace written to {self.profile_dir}", flush=True)
+
+
 def apply_forced_platform(env: Optional[Dict[str, str]] = None) -> None:
     """Honor TPUJOB_FORCE_PLATFORM (e.g. 'cpu' for hermetic e2e tests).
 
