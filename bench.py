@@ -578,7 +578,7 @@ def child_attention() -> None:
     import jax.numpy as jnp
 
     from tf_operator_tpu.ops.attention import (
-        _on_tpu, _repeat_kv, flash_attention, xla_attention,
+        _on_tpu, repeat_kv, flash_attention, xla_attention,
     )
 
     seqs = [int(s) for s in os.environ.get(
@@ -621,7 +621,7 @@ def child_attention() -> None:
             row["kv_heads"] = kv_h
 
         def widened_xla(q, k, v):
-            return xla_attention(q, *_repeat_kv(q, k, v), causal=True)
+            return xla_attention(q, *repeat_kv(q, k, v), causal=True)
 
         flash_s = xla_s = None
         try:

@@ -92,6 +92,21 @@ spec:
 """
 
 
+def test_all_shipped_examples_are_valid():
+    """Every examples/*/tpujob.yaml parses, defaults, and validates — the
+    shipped example matrix can't rot silently."""
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent / "examples"
+    manifests = sorted(root.glob("*/tpujob.yaml"))
+    assert len(manifests) >= 7, [str(m) for m in manifests]
+    for path in manifests:
+        job = job_from_manifest(path.read_text())
+        set_defaults(job)
+        validate(job)
+        assert job.metadata.name, str(path)
+
+
 def test_reference_dist_mnist_ingested():
     """The reference's examples/v1 dist-mnist YAML loads unmodified."""
     job = job_from_manifest(REFERENCE_DIST_MNIST)

@@ -150,9 +150,9 @@ class SelfAttention(nn.Module):
         elif cfg.use_flash:
             out = flash_attention(q, k, v, cfg.causal)
         else:
-            from ..ops.attention import _repeat_kv
+            from ..ops.attention import repeat_kv
 
-            out = xla_attention(q, *_repeat_kv(q, k, v), causal=cfg.causal)
+            out = xla_attention(q, *repeat_kv(q, k, v), causal=cfg.causal)
         out = out.transpose(0, 2, 1, 3)  # [B, T, H, D]
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out",

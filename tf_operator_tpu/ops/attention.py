@@ -491,7 +491,7 @@ def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None
     return xla_attention_lse(q, k, v, causal=causal, scale=scale)[0]
 
 
-def _repeat_kv(q, k, v):
+def repeat_kv(q, k, v):
     """Widen GQA k/v to q's head count (the repeat-in-HBM fallback the
     Pallas kernels avoid via index maps)."""
     group = q.shape[1] // k.shape[1]
@@ -500,7 +500,7 @@ def _repeat_kv(q, k, v):
     return jnp.repeat(k, group, axis=1), jnp.repeat(v, group, axis=1)
 
 
-def _check_gqa(q, k):
+def check_gqa(q, k):
     if q.shape[1] % k.shape[1]:
         raise ValueError(
             f"q heads {q.shape[1]} must be a multiple of kv heads {k.shape[1]}"
@@ -519,23 +519,23 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=128, block_k=128):
     """Fused attention; Pallas kernels (fwd + bwd) on TPU, XLA elsewhere.
     k/v may carry fewer (grouped-query) heads than q — the kernels never
     repeat them in HBM; the XLA fallback widens them explicitly."""
-    _check_gqa(q, k)
+    check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     if _on_tpu():
         out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k,
                                 interpret=False, save_lse=False)
         return out
-    return xla_attention(q, *_repeat_kv(q, k, v), causal=causal, scale=s)
+    return xla_attention(q, *repeat_kv(q, k, v), causal=causal, scale=s)
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
-    _check_gqa(q, k)
+    check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     if _on_tpu():
         out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
                                   interpret=False)
         return out, (q, k, v, out, lse)
-    out = xla_attention(q, *_repeat_kv(q, k, v), causal=causal, scale=s)
+    out = xla_attention(q, *repeat_kv(q, k, v), causal=causal, scale=s)
     return out, (q, k, v, None, None)
 
 
@@ -547,7 +547,7 @@ def _bwd(causal, scale, block_q, block_k, res, g):
                                block_q, block_k, interpret=False)
     _, vjp = jax.vjp(
         lambda q, k, v: xla_attention(
-            q, *_repeat_kv(q, k, v), causal=causal, scale=s
+            q, *repeat_kv(q, k, v), causal=causal, scale=s
         ),
         q, k, v,
     )
@@ -589,25 +589,25 @@ def flash_attention_lse(q, k, v, causal=True, scale=None,
     elsewhere.  Differentiable in BOTH outputs (the lse cotangent folds into
     the backward's delta term — see _flash_backward).  GQA k/v supported as
     in flash_attention."""
-    _check_gqa(q, k)
+    check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     if _on_tpu():
         batch, heads, t, _ = q.shape
         out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
                                   interpret=False)
         return out, lse[:, :t].reshape(batch, heads, t)
-    return xla_attention_lse(q, *_repeat_kv(q, k, v), causal=causal, scale=s)
+    return xla_attention_lse(q, *repeat_kv(q, k, v), causal=causal, scale=s)
 
 
 def _fwd_lse(q, k, v, causal, scale, block_q, block_k):
-    _check_gqa(q, k)
+    check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     if _on_tpu():
         batch, heads, t, _ = q.shape
         out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
                                   interpret=False)
         return (out, lse[:, :t].reshape(batch, heads, t)), (q, k, v, out, lse)
-    out, lse = xla_attention_lse(q, *_repeat_kv(q, k, v), causal=causal, scale=s)
+    out, lse = xla_attention_lse(q, *repeat_kv(q, k, v), causal=causal, scale=s)
     return (out, lse), (q, k, v, None, None)
 
 
@@ -621,7 +621,7 @@ def _bwd_lse(causal, scale, block_q, block_k, res, gs):
                                g_lse=g_lse)
     _, vjp = jax.vjp(
         lambda q, k, v: xla_attention_lse(
-            q, *_repeat_kv(q, k, v), causal=causal, scale=s
+            q, *repeat_kv(q, k, v), causal=causal, scale=s
         ),
         q, k, v,
     )

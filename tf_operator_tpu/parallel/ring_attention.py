@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.attention import _check_gqa, _repeat_kv, flash_attention_lse
+from ..ops.attention import check_gqa, repeat_kv, flash_attention_lse
 
 try:
     from jax import shard_map as _shard_map  # jax >= 0.8 (check_vma kwarg)
@@ -200,9 +200,9 @@ def ring_attention(
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    _check_gqa(q, k)
+    check_gqa(q, k)
     if not use_flash:
-        k, v = _repeat_kv(q, k, v)
+        k, v = repeat_kv(q, k, v)
     local = _ring_attention_local_flash if use_flash else _ring_attention_local
     spec = P(None, None, axis_name, None)
     fn = shard_map(

@@ -16,9 +16,14 @@ def main(argv=None) -> int:
     parser.add_argument("--lr", type=float, default=5e-5)
     parser.add_argument("--layers", type=int, default=12)
     parser.add_argument("--d-model", type=int, default=768)
-    args = parser.parse_args(argv)
 
-    from .runner import WorkloadContext, apply_forced_platform
+    from .runner import (
+        ProfileCapture, WorkloadContext, add_profile_args,
+        apply_forced_platform,
+    )
+
+    add_profile_args(parser)
+    args = parser.parse_args(argv)
 
     apply_forced_platform()
 
@@ -59,7 +64,9 @@ def main(argv=None) -> int:
     state = shard_train_state(state, mesh)
     step = make_train_step(classification_loss_fn(apply_logits))
     rng = np.random.RandomState(ctx.replica_index)
+    prof = ProfileCapture.from_args(args)
     for i in range(args.steps):
+        prof.step(i)
         batch = {
             "x": rng.randint(0, cfg.vocab_size, (args.batch, args.seq_len)).astype(np.int32),
             "label": rng.randint(0, 2, args.batch).astype(np.int32),
@@ -67,6 +74,7 @@ def main(argv=None) -> int:
         state, metrics = step(state, shard_batch(batch, mesh))
         if i % 10 == 0:
             print(f"step {i} loss {float(metrics['loss']):.4f}", flush=True)
+    prof.close()
     print("done", flush=True)
     return 0
 

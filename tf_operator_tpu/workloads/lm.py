@@ -29,10 +29,9 @@ def main(argv=None) -> int:
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="enable MoE with this many experts (ep-sharded)")
     parser.add_argument("--moe-aux-weight", type=float, default=0.01)
-    parser.add_argument("--profile-dir", default=None,
-                        help="capture a jax.profiler trace here")
-    parser.add_argument("--profile-start", type=int, default=2)
-    parser.add_argument("--profile-steps", type=int, default=3)
+    from .runner import add_profile_args
+
+    add_profile_args(parser)
     parser.add_argument("--arch", choices=("gpt", "llama"), default="gpt",
                         help="gpt: learned positions + LayerNorm + GELU; "
                              "llama: RoPE + RMSNorm + SwiGLU + GQA")

@@ -35,13 +35,17 @@ def main(argv=None) -> int:
     parser.add_argument("--preempt-exit-code", type=int, default=143,
                         help="143=SIGTERM, retryable per the exit-code "
                         "classifier (train_util.go:18-53 analogue)")
-    args = parser.parse_args(argv)
-
 
     # Test hook: the local runtime forces CPU for pod subprocesses so they
     # don't contend for the host's TPU (sitecustomize pins jax_platforms,
     # so env alone is not enough — see tests/conftest.py).
-    from .runner import WorkloadContext, apply_forced_platform
+    from .runner import (
+        ProfileCapture, WorkloadContext, add_profile_args,
+        apply_forced_platform,
+    )
+
+    add_profile_args(parser)
+    args = parser.parse_args(argv)
 
     apply_forced_platform()
 
@@ -88,7 +92,10 @@ def main(argv=None) -> int:
 
     data = synthetic_mnist(args.batch, seed=ctx.replica_index)
     loss = float("inf")
+    prof = ProfileCapture(args.profile_dir, start_step + args.profile_start,
+                          args.profile_steps)
     for i in range(start_step, args.steps):
+        prof.step(i)
         state, metrics = step(state, next(data))
         loss = float(metrics["loss"])
         if i % 10 == 0:
@@ -101,6 +108,7 @@ def main(argv=None) -> int:
             return args.preempt_exit_code
         if ckpt is not None and args.save_every and done % args.save_every == 0:
             ckpt.save(state, step=done)
+    prof.close()
     print(f"final loss {loss:.4f}", flush=True)
     if args.target_loss is not None and loss > args.target_loss:
         print(f"target loss {args.target_loss} not reached", flush=True)

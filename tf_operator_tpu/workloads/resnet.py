@@ -23,13 +23,13 @@ def main(argv=None) -> int:
     parser.add_argument("--lr", type=float, default=0.1)
     parser.add_argument("--depth", type=int, default=50, choices=(18, 34, 50, 101, 152))
     parser.add_argument("--log-every", type=int, default=10)
-    parser.add_argument("--profile-dir", default=None,
-                        help="capture a jax.profiler trace here")
-    parser.add_argument("--profile-start", type=int, default=2)
-    parser.add_argument("--profile-steps", type=int, default=3)
-    args = parser.parse_args(argv)
+    from .runner import (
+        ProfileCapture, WorkloadContext, add_profile_args,
+        apply_forced_platform,
+    )
 
-    from .runner import ProfileCapture, WorkloadContext, apply_forced_platform
+    add_profile_args(parser)
+    args = parser.parse_args(argv)
 
     apply_forced_platform()
 
@@ -67,8 +67,7 @@ def main(argv=None) -> int:
         has_batch_stats=True,
     )
     data = images_or_fallback(args.batch, args.image_size, args.num_classes)
-    prof = ProfileCapture(args.profile_dir, args.profile_start,
-                          args.profile_steps)
+    prof = ProfileCapture.from_args(args)
     t_start = time.time()
     for i in range(args.steps):
         prof.step(i)

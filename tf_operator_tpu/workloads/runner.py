@@ -38,6 +38,11 @@ class ProfileCapture:
         self.start_step = start_step
         self.stop_step = start_step + num_steps
         self._running = False
+        self._captured = False
+
+    @classmethod
+    def from_args(cls, args) -> "ProfileCapture":
+        return cls(args.profile_dir, args.profile_start, args.profile_steps)
 
     def step(self, i: int) -> None:
         if not self.profile_dir:
@@ -48,17 +53,32 @@ class ProfileCapture:
             jax.profiler.start_trace(self.profile_dir)
             self._running = True
         elif i == self.stop_step and self._running:
-            jax.profiler.stop_trace()
-            self._running = False
-            print(f"profile trace written to {self.profile_dir}", flush=True)
+            self._stop()
 
     def close(self) -> None:
         if self._running:
-            import jax
+            self._stop()
+        elif self.profile_dir and not self._captured:
+            # asked for a profile, never reached the window — say so rather
+            # than exit 0 with an empty directory
+            print(f"warning: profile window (start step {self.start_step}) "
+                  f"was never reached; no trace written", flush=True)
 
-            jax.profiler.stop_trace()
-            self._running = False
-            print(f"profile trace written to {self.profile_dir}", flush=True)
+    def _stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        self._running = False
+        self._captured = True
+        print(f"profile trace written to {self.profile_dir}", flush=True)
+
+
+def add_profile_args(parser) -> None:
+    """The shared --profile-* CLI surface for training workloads."""
+    parser.add_argument("--profile-dir", default=None,
+                        help="capture a jax.profiler trace here")
+    parser.add_argument("--profile-start", type=int, default=2)
+    parser.add_argument("--profile-steps", type=int, default=3)
 
 
 def apply_forced_platform(env: Optional[Dict[str, str]] = None) -> None:
