@@ -203,6 +203,33 @@ def test_real_mnist_training_job(local_stack):
     assert any("final loss" in t for t in logs.values())
 
 
+def test_llama_training_job(local_stack):
+    """The llama family (RoPE/RMSNorm/SwiGLU/GQA) trains to completion as a
+    controller-launched pod process — the model-zoo path through the real
+    control plane, not just a unit test."""
+    cluster, controller, client, tmp = local_stack
+    job = TPUJob(
+        metadata=ObjectMeta(name="llama-tiny"),
+        spec=TPUJobSpec(replica_specs={
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=1,
+                template=PodTemplateSpec(containers=[Container(
+                    name="tensorflow", image="local",
+                    command=[sys.executable, "-m", "tf_operator_tpu.workloads.lm"],
+                    args=["--arch", "llama", "--steps", "6", "--batch", "8",
+                          "--seq-len", "32", "--vocab", "128", "--layers", "1",
+                          "--d-model", "64"],
+                )]),
+            )
+        }),
+    )
+    client.create(job)
+    client.wait_for_job("llama-tiny", timeout=240)
+    logs = client.get_logs("llama-tiny")
+    assert client.is_job_succeeded("llama-tiny"), logs
+    assert any("done" in t for t in logs.values())
+
+
 @pytest.mark.slow
 def test_multiprocess_jax_distributed_collective(local_stack):
     """Two controller-launched worker processes form a real jax.distributed

@@ -13,10 +13,11 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import batch_sharding, replicated
+from ..parallel.mesh import batch_sharding, data_axes, replicated
 from ..parallel.tp_rules import make_param_shardings
 from .state import TrainState
 
@@ -141,4 +142,27 @@ def shard_train_state(state: TrainState, mesh: Mesh) -> TrainState:
 
 
 def shard_batch(batch, mesh: Mesh):
-    return jax.device_put(batch, batch_sharding(mesh))
+    # Fail with the actual constraint, not a device_put internals traceback:
+    # the leading dim of every leaf must divide the mesh's data axes.
+    # Rank-0 leaves (e.g. a scalar loss weight) have no batch dim and are
+    # replicated instead.
+    n_data = int(np.prod([mesh.shape[a] for a in data_axes(mesh)], initial=1))
+    if n_data > 1:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(batch)[0]:
+            shape = getattr(leaf, "shape", ())
+            if shape and shape[0] % n_data:
+                name = "/".join(str(getattr(k, "key", k)) for k in path)
+                raise ValueError(
+                    f"batch leaf {name!r} has leading dim {shape[0]}, which "
+                    f"the mesh's data axes (size {n_data}, mesh "
+                    f"{dict(mesh.shape)}) don't divide — use a batch that "
+                    f"is a multiple of {n_data}"
+                )
+    data = batch_sharding(mesh)
+    repl = replicated(mesh)
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(
+            leaf, data if getattr(leaf, "shape", ()) else repl
+        ),
+        batch,
+    )
