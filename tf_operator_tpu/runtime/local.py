@@ -33,11 +33,20 @@ log = tpulog.logger_for_key("local-cluster")
 
 
 class LocalProcessCluster(InMemoryCluster):
-    def __init__(self, workdir: Optional[str] = None, base_port: int = 20000,
+    def __init__(self, workdir: Optional[str] = None,
+                 base_port: Optional[int] = None,
                  extra_env: Optional[Dict[str, str]] = None) -> None:
         super().__init__()
         self.workdir = Path(workdir or ".tpujob-local")
         self.workdir.mkdir(parents=True, exist_ok=True)
+        if base_port is None:
+            # Spread the default range by PID: two clusters in different
+            # processes (e.g. concurrent pytest runs) must not hand the
+            # same 127.0.0.1 port to different jobs' coordinators — the
+            # colliding groups rendezvous across tests and wedge.
+            # range stays below Linux's ephemeral ports (32768+) so no
+            # kernel-assigned outgoing connection can squat a replica port
+            base_port = 20000 + (os.getpid() * 2654435761 >> 8) % 12000
         self.base_port = base_port
         self.extra_env = dict(extra_env or {})
         self._procs: Dict[Tuple[str, str], subprocess.Popen] = {}
