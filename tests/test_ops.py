@@ -95,6 +95,33 @@ def test_flash_gqa_kernels_match_repeated_reference(causal, t):
     np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_ref), atol=1e-4)
 
 
+@pytest.mark.parametrize("d,bq,bk", [(128, 64, 64), (32, 64, 128)])
+def test_flash_gqa_other_head_dims_and_blocks(d, bq, bk):
+    """GQA kernels at MXU-width head_dim (128) and asymmetric q/k blocks —
+    the index-map arithmetic must not depend on the 64/64 defaults."""
+    t, h, kv_h, group = 128, 4, 2, 2
+    q, _, _ = qkv(t, d=d, b=1, h=h, seed=11)
+    keys = jax.random.split(jax.random.PRNGKey(12), 2)
+    k = jax.random.normal(keys[0], (1, kv_h, t, d))
+    v = jax.random.normal(keys[1], (1, kv_h, t, d))
+    g = jax.random.normal(jax.random.PRNGKey(13), q.shape)
+
+    out, dq, dk, dv = flash_attention_grads_interpret(
+        q, k, v, g, True, None, bq, bk)
+    kw, vw = (jnp.repeat(x, group, axis=1) for x in (k, v))
+    ref, vjp = jax.vjp(
+        lambda q, k, v: xla_attention(q, k, v, causal=True), q, kw, vw)
+    dq_ref, dkw, dvw = vjp(g)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_ref), atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(dk), np.asarray(dkw.reshape(1, kv_h, group, t, d).sum(2)),
+        atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(dv), np.asarray(dvw.reshape(1, kv_h, group, t, d).sum(2)),
+        atol=1e-4)
+
+
 def test_flash_gqa_rejects_indivisible_heads():
     q, k, v = qkv(64, d=16, h=3)
     with pytest.raises(ValueError, match="multiple of kv heads"):
