@@ -187,6 +187,7 @@ def _throughput(platform, stages, model):
     else:
         ladder = [int(os.environ.get("BENCH_CPU_BATCH", "4"))]
         base_env = _cpu_fallback_env()
+    best_partial = None
     for batch in ladder:
         env = dict(base_env, BENCH_BATCH=str(batch), BENCH_MODEL=model)
         t0 = time.time()
@@ -201,13 +202,20 @@ def _throughput(platform, stages, model):
                        **({} if parsed else {"err": err[-300:]})})
         if parsed is not None:
             parsed["platform"] = platform or "cpu"
-            return parsed
+            if rc != -9:
+                return parsed  # complete result: both arms measured
+            # A partial emitted before the child's timeout is a fallback,
+            # not an answer — keep stepping the ladder for a complete
+            # vs_baseline at a smaller batch.
+            parsed["child_timed_out"] = True
+            if best_partial is None:
+                best_partial = parsed
         if platform is not None and rc == -9 and not _backend_alive(
                 stages, f"throughput:{model}"):
             # Timed out AND the backend no longer answers: the rest of the
             # ladder would hang the same way.  Stop here.
-            return None
-    return None
+            return best_partial
+    return best_partial
 
 
 def _attention_ladder(platform, stages):
@@ -226,6 +234,11 @@ def _attention_ladder(platform, stages):
                    "sec": round(time.time() - t0, 1),
                    "ok": parsed is not None,
                    **({} if parsed else {"err": err[-300:]})})
+    if parsed is not None and rc == -9:
+        # rows measured before the wedge, but the ladder is truncated —
+        # must not read as a complete run
+        parsed["child_timed_out"] = True
+        parsed["partial"] = "ladder truncated by child timeout"
     return parsed
 
 
@@ -294,7 +307,8 @@ def orchestrate() -> None:
     try:
         platform = _probe_backend(stages)
         results[MODEL] = _throughput(platform, stages, MODEL)
-        tpu_suspect = platform is not None and results[MODEL] is None
+        tpu_suspect = platform is not None and bool(
+            results[MODEL] is None or results[MODEL].get("child_timed_out"))
         other = "lm" if MODEL == "resnet" else "resnet"
         if not os.environ.get("BENCH_SKIP_SECOND_MODEL"):
             if tpu_dead(f"throughput:{other}"):
@@ -304,7 +318,8 @@ def orchestrate() -> None:
                 results[other] = _throughput(platform, stages, other)
                 if platform is not None:
                     # this stage's outcome is the freshest liveness evidence
-                    tpu_suspect = results[other] is None
+                    tpu_suspect = (results[other] is None
+                                   or bool(results[other].get("child_timed_out")))
     except Exception as e:  # noqa: BLE001 — the one JSON line must still print
         stages.append({"stage": "orchestrator", "err": repr(e)[:300]})
     attention = None
@@ -538,13 +553,22 @@ def child_throughput() -> None:
         metric = f"resnet50_train_images_per_sec_bf16_b{batch_size}_i{image}"
         mfu_of = None
 
-    fw_sps, fw_windows = _steps_per_sec(
-        lambda s, b: fw_raw(s, b), state, batch, steps, windows)
-    bare_sps, bare_windows = _steps_per_sec(
-        bare_raw, bare_state, batch, steps, windows)
-
     def pct_spread(ws):
         return round(100.0 * (max(ws) - min(ws)) / max(ws), 2)
+
+    fw_sps, fw_windows = _steps_per_sec(
+        lambda s, b: fw_raw(s, b), state, batch, steps, windows)
+    # Emit the framework arm as soon as it lands: if the flaky tunnel
+    # wedges during the bare arm, the parent's _last_json still gets a
+    # usable partial (vs_baseline absent, flagged) instead of nothing.
+    print(json.dumps({
+        "metric": metric, "value": round(fw_sps * per_step, 2), "unit": unit,
+        "vs_baseline": None, "partial": "bare arm not yet measured",
+        "fw_windows_per_sec": [round(w * per_step, 2) for w in fw_windows],
+        "fw_spread_pct": pct_spread(fw_windows),
+    }), flush=True)
+    bare_sps, bare_windows = _steps_per_sec(
+        bare_raw, bare_state, batch, steps, windows)
 
     out = {
         "metric": metric,
@@ -637,13 +661,15 @@ def child_attention() -> None:
         if flash_s and xla_s:  # ratio from raw timings, rounded for display
             row["speedup"] = round(xla_s / flash_s, 3)
         rows.append(row)
-    print(json.dumps({
-        "fwd_bwd": rows, "shape": {"b": b, "h": h, "d": d},
-        # Off-TPU flash_attention resolves to xla_attention, so both arms
-        # time the same code — flag that so the rows can't be misread as a
-        # kernel result.
-        "kernel_path": "pallas" if _on_tpu() else "xla-fallback (no kernel)",
-    }))
+        # Emit after every row: a tunnel wedge mid-ladder keeps the rows
+        # already measured (parent takes the last complete JSON line).
+        print(json.dumps({
+            "fwd_bwd": rows, "shape": {"b": b, "h": h, "d": d},
+            # Off-TPU flash_attention resolves to xla_attention, so both
+            # arms time the same code — flag that so the rows can't be
+            # misread as a kernel result.
+            "kernel_path": "pallas" if _on_tpu() else "xla-fallback (no kernel)",
+        }), flush=True)
 
 
 # ---------------------------------------------------------------------------
