@@ -202,12 +202,12 @@ def _throughput(platform, stages, model):
                        **({} if parsed else {"err": err[-300:]})})
         if parsed is not None:
             parsed["platform"] = platform or "cpu"
-            if rc != -9:
+            if rc == 0:
                 return parsed  # complete result: both arms measured
-            # A partial emitted before the child's timeout is a fallback,
-            # not an answer — keep stepping the ladder for a complete
-            # vs_baseline at a smaller batch.
-            parsed["child_timed_out"] = True
+            # A partial emitted before the child died (timeout OR crash) is
+            # a fallback, not an answer — keep stepping the ladder for a
+            # complete vs_baseline at a smaller batch.
+            parsed["partial_rc"] = rc
             if best_partial is None:
                 best_partial = parsed
         if platform is not None and rc == -9 and not _backend_alive(
@@ -234,11 +234,11 @@ def _attention_ladder(platform, stages):
                    "sec": round(time.time() - t0, 1),
                    "ok": parsed is not None,
                    **({} if parsed else {"err": err[-300:]})})
-    if parsed is not None and rc == -9:
-        # rows measured before the wedge, but the ladder is truncated —
-        # must not read as a complete run
-        parsed["child_timed_out"] = True
-        parsed["partial"] = "ladder truncated by child timeout"
+    if parsed is not None and rc != 0:
+        # rows measured before the child died (timeout or crash), but the
+        # ladder is truncated — must not read as a complete run
+        parsed["partial_rc"] = rc
+        parsed["partial"] = "ladder truncated by child exit"
     return parsed
 
 
@@ -308,7 +308,7 @@ def orchestrate() -> None:
         platform = _probe_backend(stages)
         results[MODEL] = _throughput(platform, stages, MODEL)
         tpu_suspect = platform is not None and bool(
-            results[MODEL] is None or results[MODEL].get("child_timed_out"))
+            results[MODEL] is None or results[MODEL].get("partial_rc"))
         other = "lm" if MODEL == "resnet" else "resnet"
         if not os.environ.get("BENCH_SKIP_SECOND_MODEL"):
             if tpu_dead(f"throughput:{other}"):
@@ -319,7 +319,7 @@ def orchestrate() -> None:
                 if platform is not None:
                     # this stage's outcome is the freshest liveness evidence
                     tpu_suspect = (results[other] is None
-                                   or bool(results[other].get("child_timed_out")))
+                                   or bool(results[other].get("partial_rc")))
     except Exception as e:  # noqa: BLE001 — the one JSON line must still print
         stages.append({"stage": "orchestrator", "err": repr(e)[:300]})
     attention = None
@@ -558,29 +558,27 @@ def child_throughput() -> None:
 
     fw_sps, fw_windows = _steps_per_sec(
         lambda s, b: fw_raw(s, b), state, batch, steps, windows)
-    # Emit the framework arm as soon as it lands: if the flaky tunnel
-    # wedges during the bare arm, the parent's _last_json still gets a
-    # usable partial (vs_baseline absent, flagged) instead of nothing.
-    print(json.dumps({
-        "metric": metric, "value": round(fw_sps * per_step, 2), "unit": unit,
-        "vs_baseline": None, "partial": "bare arm not yet measured",
-        "fw_windows_per_sec": [round(w * per_step, 2) for w in fw_windows],
-        "fw_spread_pct": pct_spread(fw_windows),
-    }), flush=True)
-    bare_sps, bare_windows = _steps_per_sec(
-        bare_raw, bare_state, batch, steps, windows)
-
     out = {
         "metric": metric,
         "value": round(fw_sps * per_step, 2),
         "unit": unit,
-        "vs_baseline": round(fw_sps / bare_sps, 4),
+        "vs_baseline": None,
         "windows": windows,
         "fw_windows_per_sec": [round(w * per_step, 2) for w in fw_windows],
-        "bare_windows_per_sec": [round(w * per_step, 2) for w in bare_windows],
         "fw_spread_pct": pct_spread(fw_windows),
-        "bare_spread_pct": pct_spread(bare_windows),
     }
+    # Emit the framework arm as soon as it lands: if the flaky tunnel
+    # wedges during the bare arm, the parent's _last_json still gets a
+    # usable partial (vs_baseline absent, flagged) instead of nothing.
+    print(json.dumps({**out, "partial": "bare arm not yet measured"}),
+          flush=True)
+    bare_sps, bare_windows = _steps_per_sec(
+        bare_raw, bare_state, batch, steps, windows)
+    out.update(
+        vs_baseline=round(fw_sps / bare_sps, 4),
+        bare_windows_per_sec=[round(w * per_step, 2) for w in bare_windows],
+        bare_spread_pct=pct_spread(bare_windows),
+    )
     if model_kind == "lm" and mfu_of is not None:
         from tf_operator_tpu.ops.attention import _on_tpu
 

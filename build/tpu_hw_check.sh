@@ -12,12 +12,25 @@ echo "== probe =="
 timeout 120 python -c "import jax; print(jax.devices())" || {
   echo "TPU unreachable; aborting"; exit 1; }
 
+# Write captures to a temp file first and only replace the artifact when
+# the capture is non-empty: a wedged tunnel + timeout kill must not
+# truncate a previously recorded artifact.
 echo "== hardware test tier =="
 TPUJOB_TEST_PLATFORM=tpu timeout 1200 python -m pytest tests/ -m tpu -v \
-  2>&1 | tail -40 | tee "artifacts/tpu_tier_${STAMP}.log"
+  2>&1 | tail -40 > "artifacts/.tier.tmp"
+if [ -s "artifacts/.tier.tmp" ]; then
+  mv "artifacts/.tier.tmp" "artifacts/tpu_tier_${STAMP}.log"
+  cat "artifacts/tpu_tier_${STAMP}.log"
+fi
 
 echo "== bench (both models + attention ladder + control plane + native) =="
-timeout 3600 python bench.py 2>&1 | tail -1 \
-  | tee "artifacts/bench_${STAMP}.json"
+timeout 3600 python bench.py 2>&1 | tail -1 > "artifacts/.bench.tmp"
+if [ -s "artifacts/.bench.tmp" ]; then
+  mv "artifacts/.bench.tmp" "artifacts/bench_${STAMP}.json"
+  cat "artifacts/bench_${STAMP}.json"
+fi
 
-echo "done: artifacts/tpu_tier_${STAMP}.log artifacts/bench_${STAMP}.json"
+rm -f "artifacts/.tier.tmp" "artifacts/.bench.tmp"
+echo "recorded artifacts for stamp ${STAMP}:"
+ls "artifacts/tpu_tier_${STAMP}.log" "artifacts/bench_${STAMP}.json" 2>/dev/null \
+  || echo "(some captures produced no output and were not recorded)"

@@ -79,27 +79,82 @@ def classification_loss_fn(apply_fn, has_batch_stats: bool = False,
 
 
 def make_train_step(loss_fn, has_batch_stats: bool = False, donate: bool = True,
-                    jit: bool = True):
+                    jit: bool = True, grad_accum: int = 1):
     """Build `step(state, batch, rng) -> (state, metrics)` under jit.
 
     jit=False returns the raw traceable step for callers that embed it in a
-    larger compiled region (e.g. `lax.scan` over steps in bench harnesses)."""
+    larger compiled region (e.g. `lax.scan` over steps in bench harnesses).
+
+    grad_accum > 1 splits the batch's leading dim into that many
+    microbatches and accumulates their mean gradient in a `lax.scan` before
+    the single optimizer update — same optimizer math as one big batch
+    (exact for mean-reduced losses), HBM held to one microbatch of
+    activations.  The per-device trade XLA sees: grad_accum× smaller
+    live activation sets, same MXU work."""
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
 
     def step(state: TrainState, batch, rng=None):
-        rngs = {"dropout": rng} if rng is not None else None
-
-        def compute(params):
+        def compute(params, mb, bs, rngs):
             if has_batch_stats:
-                loss, aux = loss_fn(params, batch, state.batch_stats, rngs=rngs)
+                loss, aux = loss_fn(params, mb, bs, rngs=rngs)
             else:
-                loss, aux = loss_fn(params, batch, rngs=rngs)
+                loss, aux = loss_fn(params, mb, rngs=rngs)
             return loss, aux
 
-        (loss, aux), grads = jax.value_and_grad(compute, has_aux=True)(state.params)
-        new_state = state.apply_gradients(grads, aux.get("batch_stats"))
-        metrics = {"loss": loss}
-        if "moe_aux_loss" in aux:
-            metrics["moe_aux_loss"] = aux["moe_aux_loss"]
+        if grad_accum == 1:
+            rngs = {"dropout": rng} if rng is not None else None
+            (loss, aux), grads = jax.value_and_grad(compute, has_aux=True)(
+                state.params, batch, state.batch_stats, rngs)
+            new_state = state.apply_gradients(grads, aux.get("batch_stats"))
+            metrics = {"loss": loss}
+            if "moe_aux_loss" in aux:
+                metrics["moe_aux_loss"] = aux["moe_aux_loss"]
+            return new_state, metrics
+
+        def split(x):
+            shape = getattr(x, "shape", ())
+            if not shape:
+                return x
+            if shape[0] % grad_accum:
+                raise ValueError(
+                    f"batch leading dim {shape[0]} must divide by "
+                    f"grad_accum={grad_accum}"
+                )
+            return x.reshape((grad_accum, shape[0] // grad_accum) + shape[1:])
+
+        micro = jax.tree_util.tree_map(split, batch)
+        zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+        has_moe = []  # set at trace time (scan traces body once)
+
+        def body(carry, xs):
+            gsum, loss_sum, aux_sum, bs = carry
+            mb, idx = xs
+            rngs = (
+                {"dropout": jax.random.fold_in(rng, idx)}
+                if rng is not None else None
+            )
+            (loss, aux), g = jax.value_and_grad(compute, has_aux=True)(
+                state.params, mb, bs, rngs)
+            gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+            if has_batch_stats:
+                bs = aux["batch_stats"]
+            if "moe_aux_loss" in aux:  # Python-level: aux keys are static
+                has_moe.append(True)
+                aux_sum = aux_sum + aux["moe_aux_loss"]
+            return (gsum, loss_sum + loss, aux_sum, bs), None
+
+        (gsum, loss_sum, aux_sum, bs), _ = jax.lax.scan(
+            body,
+            (zero_grads, jnp.float32(0.0), jnp.float32(0.0), state.batch_stats),
+            (micro, jnp.arange(grad_accum)),
+        )
+        grads = jax.tree_util.tree_map(lambda g: g / grad_accum, gsum)
+        new_state = state.apply_gradients(
+            grads, bs if has_batch_stats else None)
+        metrics = {"loss": loss_sum / grad_accum}
+        if has_moe:
+            metrics["moe_aux_loss"] = aux_sum / grad_accum
         return new_state, metrics
 
     if not jit:

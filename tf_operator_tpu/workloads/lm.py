@@ -26,6 +26,9 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpoint-dir", default=None)
     parser.add_argument("--checkpoint-every", type=int, default=20)
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="microbatches per optimizer step (activation "
+                             "memory / N, same update math)")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="enable MoE with this many experts (ep-sharded)")
     parser.add_argument("--moe-aux-weight", type=float, default=0.01)
@@ -42,6 +45,11 @@ def main(argv=None) -> int:
     from .runner import ProfileCapture, WorkloadContext, apply_forced_platform
 
     apply_forced_platform()
+
+    if args.grad_accum < 1 or args.batch % args.grad_accum:
+        print(f"--grad-accum {args.grad_accum} must be >= 1 and divide "
+              f"--batch {args.batch}", flush=True)
+        return 2
 
     ctx = WorkloadContext.from_env()
     print(f"lm workload: role={ctx.replica_type} index={ctx.replica_index} "
@@ -141,7 +149,7 @@ def main(argv=None) -> int:
     step = make_train_step(lm_loss_fn(
         model.apply,
         moe_aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
-    ))
+    ), grad_accum=args.grad_accum)
     data = synthetic_tokens(args.batch, args.seq_len + 1, args.vocab)
     start = int(state.step)
     prof = ProfileCapture(args.profile_dir, start + args.profile_start,
