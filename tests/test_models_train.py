@@ -182,6 +182,31 @@ def test_lm_use_flash_false_matches_flash_path():
         np.asarray(out), np.asarray(out_xla), atol=1e-5)
 
 
+def test_remat_matches_plain_forward_and_trains():
+    """cfg.remat (per-block jax.checkpoint) must change memory, not math:
+    identical logits on the same params, and grads still flow."""
+    base = dict(vocab_size=64, num_layers=2, num_heads=2, d_model=16,
+                d_ff=32, max_len=16, dtype=jnp.float32)
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 64)
+    plain = TransformerLM(TransformerConfig(**base))
+    remat = TransformerLM(TransformerConfig(**base, remat=True))
+    params = plain.init(jax.random.PRNGKey(1), toks)
+    np.testing.assert_allclose(
+        np.asarray(plain.apply(params, toks)),
+        np.asarray(remat.apply(params, toks)), atol=1e-6)
+
+    def loss(m, p):
+        logits = m.apply(p, toks)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.mean(jnp.take_along_axis(logp[:, :-1], toks[:, 1:, None], -1))
+
+    g_plain = jax.grad(lambda p: loss(plain, p))(params)
+    g_remat = jax.grad(lambda p: loss(remat, p))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_plain),
+                    jax.tree_util.tree_leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
 class TestGradAccumulation:
     """grad_accum=N microbatching: same optimizer math as one big batch
     (mean-reduced loss => mean of microbatch grads == full-batch grad)."""
