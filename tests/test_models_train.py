@@ -182,6 +182,28 @@ def test_lm_use_flash_false_matches_flash_path():
         np.asarray(out), np.asarray(out_xla), atol=1e-5)
 
 
+def test_eval_step_metrics():
+    """make_eval_step: forward-only loss+accuracy, no state mutation, and a
+    trained model scores higher accuracy than an untrained one."""
+    from tf_operator_tpu.train.step import classification_metrics, make_eval_step
+
+    model = MnistMLP()
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, optax.adam(1e-3), jnp.zeros((2, 784)))
+    data = synthetic_mnist(64)
+    batch = next(data)
+    eval_step = make_eval_step(classification_metrics(model.apply))
+    before = eval_step(state, batch)
+    assert set(before) == {"loss", "accuracy"}
+
+    train = make_train_step(classification_loss_fn(model.apply), donate=False)
+    for _ in range(25):
+        state, _ = train(state, next(data))
+    after = eval_step(state, batch)
+    assert float(after["accuracy"]) > float(before["accuracy"])
+    assert float(after["loss"]) < float(before["loss"])
+
+
 def test_remat_matches_plain_forward_and_trains():
     """cfg.remat (per-block jax.checkpoint) must change memory, not math:
     identical logits on the same params, and grads still flow."""

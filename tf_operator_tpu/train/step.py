@@ -54,6 +54,12 @@ def lm_loss_fn(apply_fn, moe_aux_weight: float = 0.0):
     return loss
 
 
+def _logits(out):
+    """Unwrap a model output: dict heads expose 'logits', plain arrays are
+    the logits already."""
+    return out["logits"] if isinstance(out, dict) else out
+
+
 def classification_loss_fn(apply_fn, has_batch_stats: bool = False,
                            model_kwargs: Optional[dict] = None):
     """Image/sequence classification loss; threads BatchNorm stats."""
@@ -67,12 +73,12 @@ def classification_loss_fn(apply_fn, has_batch_stats: bool = False,
                 variables, batch["x"], mutable=["batch_stats"],
                 rngs=rngs, **model_kwargs,
             )
-            logits = out["logits"] if isinstance(out, dict) else out
+            logits = _logits(out)
             return softmax_cross_entropy(logits, batch["label"]), {
                 "batch_stats": updates["batch_stats"]
             }
         out = apply_fn(variables, batch["x"], rngs=rngs, **model_kwargs)
-        logits = out["logits"] if isinstance(out, dict) else out
+        logits = _logits(out)
         return softmax_cross_entropy(logits, batch["label"]), {}
 
     return loss
@@ -160,6 +166,39 @@ def make_train_step(loss_fn, has_batch_stats: bool = False, donate: bool = True,
     if not jit:
         return step
     return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def classification_metrics(apply_fn, model_kwargs: Optional[dict] = None):
+    """Eval-side metric fn: loss + accuracy from a forward pass (running
+    batch stats used read-only — pair with e.g. model_kwargs={'train': False}
+    for BatchNorm models)."""
+    model_kwargs = dict(model_kwargs or {})
+
+    def metric_fn(params, batch, batch_stats=None):
+        variables = {"params": params}
+        if batch_stats is not None:
+            variables["batch_stats"] = batch_stats
+        out = apply_fn(variables, batch["x"], **model_kwargs)
+        logits = _logits(out)
+        labels = batch["label"]
+        return {
+            "loss": softmax_cross_entropy(logits, labels),
+            "accuracy": jnp.mean(
+                (jnp.argmax(logits, -1) == labels).astype(jnp.float32)
+            ),
+        }
+
+    return metric_fn
+
+
+def make_eval_step(metric_fn, jit: bool = True):
+    """Build `eval_step(state, batch) -> metrics` — forward-only (no grads,
+    no state mutation), jitted by default."""
+
+    def step(state: TrainState, batch):
+        return metric_fn(state.params, batch, state.batch_stats)
+
+    return jax.jit(step) if jit else step
 
 
 def shard_train_state(state: TrainState, mesh: Mesh) -> TrainState:
