@@ -182,6 +182,69 @@ def test_lm_use_flash_false_matches_flash_path():
         np.asarray(out), np.asarray(out_xla), atol=1e-5)
 
 
+class TestLMOptimizer:
+    def test_schedule_shapes(self):
+        from tf_operator_tpu.train.optim import lr_schedule
+
+        cos = lr_schedule(1e-3, schedule="cosine", warmup_steps=10,
+                          total_steps=100)
+        assert float(cos(0)) == 0.0
+        np.testing.assert_allclose(float(cos(10)), 1e-3, rtol=1e-6)
+        assert float(cos(50)) < 1e-3
+        np.testing.assert_allclose(float(cos(100)), 1e-4, rtol=1e-5)
+
+        warm = lr_schedule(1e-3, warmup_steps=5)
+        assert float(warm(0)) == 0.0
+        np.testing.assert_allclose(float(warm(5)), 1e-3, rtol=1e-6)
+        np.testing.assert_allclose(float(warm(500)), 1e-3, rtol=1e-6)
+
+        with pytest.raises(ValueError, match="total_steps"):
+            lr_schedule(1e-3, schedule="cosine")
+        with pytest.raises(ValueError, match="schedule"):
+            lr_schedule(1e-3, schedule="linear")
+
+    def test_decay_skips_norms_and_biases(self):
+        """With enormous weight decay, matrices shrink but rank<2 params
+        (biases, norm scales) must not."""
+        from tf_operator_tpu.train.optim import lm_optimizer
+
+        params = {
+            "kernel": jnp.ones((4, 4)),
+            "bias": jnp.ones((4,)),
+            "norm_scale": jnp.ones((4,)),
+        }
+        grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        # zero grads: the only movement can come from weight decay
+        tx = lm_optimizer(1e-2, weight_decay=10.0, grad_clip=0.0)
+        opt_state = tx.init(params)
+        updates, _ = tx.update(grads, opt_state, params)
+        new = optax.apply_updates(params, updates)
+        assert float(new["kernel"][0, 0]) < 1.0  # decayed
+        np.testing.assert_allclose(np.asarray(new["bias"]), 1.0)
+        np.testing.assert_allclose(np.asarray(new["norm_scale"]), 1.0)
+
+    def test_lm_trains_with_cosine_recipe(self):
+        from tf_operator_tpu.train.optim import lm_optimizer
+        from tf_operator_tpu.train.state import create_train_state
+        from tf_operator_tpu.train.step import lm_loss_fn, make_train_step
+
+        cfg = TransformerConfig(
+            vocab_size=64, num_layers=1, num_heads=2, d_model=16, d_ff=32,
+            max_len=16, dtype=jnp.float32)
+        model = TransformerLM(cfg)
+        toks = jax.random.randint(jax.random.PRNGKey(0), (4, 17), 0, 64)
+        tx = lm_optimizer(1e-2, schedule="cosine", warmup_steps=2,
+                          total_steps=12)
+        state = create_train_state(
+            jax.random.PRNGKey(1), model, tx, toks[:2, :-1])
+        step = make_train_step(lm_loss_fn(model.apply))
+        losses = []
+        for _ in range(12):
+            state, metrics = step(state, {"tokens": toks})
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0], losses
+
+
 def test_eval_step_metrics():
     """make_eval_step: forward-only loss+accuracy, no state mutation, and a
     trained model scores higher accuracy than an untrained one."""

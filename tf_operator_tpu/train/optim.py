@@ -1,0 +1,62 @@
+"""Optimizer construction for the LM workloads.
+
+The reference delegates all of this to user containers; here the runtime
+owns the training loop, so it ships the standard modern-LM recipe: AdamW
+with linear warmup + cosine decay, global-norm gradient clipping, and
+weight decay applied only to matrices (biases, norm scales and other
+rank<2 params are excluded — decaying a RMSNorm scale toward zero is a
+bug, not regularization).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import optax
+
+
+def decay_mask(params):
+    """True for leaves weight decay applies to: rank >= 2 (matmul kernels,
+    embeddings); biases / norm scales / scalars are excluded."""
+    return jax.tree_util.tree_map(
+        lambda p: getattr(p, "ndim", 0) >= 2, params
+    )
+
+
+def lr_schedule(peak_lr: float, *, schedule: str = "constant",
+                warmup_steps: int = 0, total_steps: Optional[int] = None,
+                end_fraction: float = 0.1):
+    """A learning-rate schedule: linear warmup from 0 over `warmup_steps`,
+    then constant, or cosine decay to `end_fraction * peak_lr` by
+    `total_steps` (required for cosine)."""
+    if schedule not in ("constant", "cosine"):
+        raise ValueError(f"schedule must be 'constant'|'cosine', got {schedule!r}")
+    if schedule == "cosine":
+        if not total_steps:
+            raise ValueError("cosine schedule needs total_steps")
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=peak_lr, warmup_steps=warmup_steps,
+            decay_steps=total_steps, end_value=peak_lr * end_fraction,
+        )
+    if warmup_steps:
+        return optax.join_schedules(
+            [optax.linear_schedule(0.0, peak_lr, warmup_steps),
+             optax.constant_schedule(peak_lr)],
+            [warmup_steps],
+        )
+    return optax.constant_schedule(peak_lr)
+
+
+def lm_optimizer(peak_lr: float, *, schedule: str = "constant",
+                 warmup_steps: int = 0, total_steps: Optional[int] = None,
+                 weight_decay: float = 0.1, grad_clip: float = 1.0,
+                 b1: float = 0.9, b2: float = 0.95):
+    """AdamW + clipping + masked decay under the configured schedule."""
+    sched = lr_schedule(peak_lr, schedule=schedule,
+                        warmup_steps=warmup_steps, total_steps=total_steps)
+    parts = []
+    if grad_clip:
+        parts.append(optax.clip_by_global_norm(grad_clip))
+    parts.append(optax.adamw(sched, b1=b1, b2=b2,
+                             weight_decay=weight_decay, mask=decay_mask))
+    return optax.chain(*parts)

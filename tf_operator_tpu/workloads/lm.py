@@ -23,6 +23,11 @@ def main(argv=None) -> int:
     parser.add_argument("--layers", type=int, default=12)
     parser.add_argument("--d-model", type=int, default=768)
     parser.add_argument("--lr", type=float, default=3e-4)
+    parser.add_argument("--lr-schedule", choices=("constant", "cosine"),
+                        default="constant")
+    parser.add_argument("--warmup-steps", type=int, default=0)
+    parser.add_argument("--weight-decay", type=float, default=0.1)
+    parser.add_argument("--grad-clip", type=float, default=1.0)
     parser.add_argument("--checkpoint-dir", default=None)
     parser.add_argument("--checkpoint-every", type=int, default=20)
     parser.add_argument("--remat", action="store_true")
@@ -58,7 +63,6 @@ def main(argv=None) -> int:
 
     import jax
     import jax.numpy as jnp
-    import optax
 
     from ..models.transformer import TransformerConfig, TransformerLM
     from ..train.data import synthetic_tokens
@@ -130,9 +134,20 @@ def main(argv=None) -> int:
         # problem, reported like one (not a traceback)
         print(f"invalid model config: {e}", flush=True)
         return 2
+    from ..train.optim import lm_optimizer
+
     model = TransformerLM(cfg)
+    try:
+        tx = lm_optimizer(
+            args.lr, schedule=args.lr_schedule, warmup_steps=args.warmup_steps,
+            total_steps=args.steps, weight_decay=args.weight_decay,
+            grad_clip=args.grad_clip,
+        )
+    except ValueError as e:
+        print(f"invalid optimizer config: {e}", flush=True)
+        return 2
     state = create_train_state(
-        jax.random.PRNGKey(0), model, optax.adamw(args.lr),
+        jax.random.PRNGKey(0), model, tx,
         jnp.zeros((2, args.seq_len), jnp.int32),
     )
     state = shard_train_state(state, mesh)
