@@ -44,6 +44,30 @@ def test_save_restore_round_trip(tmp_path, trained_state):
     mgr.close()
 
 
+def test_async_save_lands_after_close(tmp_path, trained_state):
+    """wait=False saves overlap training; close() drains the writer and the
+    checkpoint is complete and restorable afterwards."""
+    model, state, step, data = trained_state
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    saved_step = mgr.save(state, wait=False)
+    # training continues while orbax writes in the background
+    state2, _ = step(state, next(data))
+    mgr.close()
+
+    mgr2 = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr2.latest_step() == saved_step
+    template = create_train_state(
+        jax.random.PRNGKey(1), model, optax.adam(1e-3), jnp.zeros((2, 784))
+    )
+    restored = mgr2.restore(template)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(restored.params),
+        jax.tree_util.tree_leaves(state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    mgr2.close()
+
+
 def test_restore_without_checkpoint_is_noop(tmp_path, trained_state):
     model, state, *_ = trained_state
     mgr = CheckpointManager(str(tmp_path / "empty"))

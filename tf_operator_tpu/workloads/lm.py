@@ -175,7 +175,9 @@ def main(argv=None) -> int:
         if i % 10 == 0:
             print(f"step {i} loss {float(metrics['loss']):.4f}", flush=True)
         if mgr is not None and (i + 1) % args.checkpoint_every == 0:
-            mgr.save(state)
+            # async: the device keeps training while orbax writes; the
+            # final save below (and close()) waits for everything
+            mgr.save(state, wait=False)
     prof.close()
     if mgr is not None:
         mgr.save(state)

@@ -107,8 +107,14 @@ def main(argv=None) -> int:
             print(f"preempted at step {done}, checkpoint saved", flush=True)
             return args.preempt_exit_code
         if ckpt is not None and args.save_every and done % args.save_every == 0:
-            ckpt.save(state, step=done)
+            # async periodic save; the preemption save above stays blocking
+            # because the process exits right after it
+            ckpt.save(state, step=done, wait=False)
     prof.close()
+    if ckpt is not None:
+        # drain in-flight async writes; a failed background save must fail
+        # the workload, not silently vanish
+        ckpt.close()
     print(f"final loss {loss:.4f}", flush=True)
     if args.target_loss is not None and loss > args.target_loss:
         print(f"target loss {args.target_loss} not reached", flush=True)
