@@ -182,6 +182,21 @@ def test_lm_use_flash_false_matches_flash_path():
         np.asarray(out), np.asarray(out_xla), atol=1e-5)
 
 
+def test_prefetch_to_device_preserves_stream():
+    """prefetch_to_device: same batches in the same order, device-resident
+    and sharded over the mesh's data axes."""
+    from tf_operator_tpu.train.data import prefetch_to_device
+
+    mesh = build_mesh({"dp": 8})
+    raw = list(x for _, x in zip(range(5), synthetic_mnist(8)))
+    out = list(prefetch_to_device(iter(raw), mesh))
+    assert len(out) == 5
+    for want, got in zip(raw, out):
+        assert got["x"].sharding.spec == jax.sharding.PartitionSpec(("dp",))
+        np.testing.assert_array_equal(np.asarray(got["x"]), want["x"])
+        np.testing.assert_array_equal(np.asarray(got["label"]), want["label"])
+
+
 class TestLMOptimizer:
     def test_schedule_shapes(self):
         from tf_operator_tpu.train.optim import lr_schedule

@@ -42,7 +42,6 @@ def main(argv=None) -> int:
     from ..train.step import (
         classification_loss_fn,
         make_train_step,
-        shard_batch,
         shard_train_state,
     )
 
@@ -63,15 +62,24 @@ def main(argv=None) -> int:
     )
     state = shard_train_state(state, mesh)
     step = make_train_step(classification_loss_fn(apply_logits))
+    from ..train.data import prefetch_to_device
+
     rng = np.random.RandomState(ctx.replica_index)
+
+    def batches():
+        while True:
+            yield {
+                "x": rng.randint(
+                    0, cfg.vocab_size, (args.batch, args.seq_len)
+                ).astype(np.int32),
+                "label": rng.randint(0, 2, args.batch).astype(np.int32),
+            }
+
+    data = prefetch_to_device(batches(), mesh)
     prof = ProfileCapture.from_args(args)
     for i in range(args.steps):
         prof.step(i)
-        batch = {
-            "x": rng.randint(0, cfg.vocab_size, (args.batch, args.seq_len)).astype(np.int32),
-            "label": rng.randint(0, 2, args.batch).astype(np.int32),
-        }
-        state, metrics = step(state, shard_batch(batch, mesh))
+        state, metrics = step(state, next(data))
         if i % 10 == 0:
             print(f"step {i} loss {float(metrics['loss']):.4f}", flush=True)
     prof.close()

@@ -65,12 +65,11 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
 
     from ..models.transformer import TransformerConfig, TransformerLM
-    from ..train.data import synthetic_tokens
+    from ..train.data import prefetch_to_device, synthetic_tokens
     from ..train.state import create_train_state
     from ..train.step import (
         lm_loss_fn,
         make_train_step,
-        shard_batch,
         shard_train_state,
     )
 
@@ -165,13 +164,14 @@ def main(argv=None) -> int:
         model.apply,
         moe_aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
     ), grad_accum=args.grad_accum)
-    data = synthetic_tokens(args.batch, args.seq_len + 1, args.vocab)
+    data = prefetch_to_device(
+        synthetic_tokens(args.batch, args.seq_len + 1, args.vocab), mesh)
     start = int(state.step)
     prof = ProfileCapture(args.profile_dir, start + args.profile_start,
                           args.profile_steps)
     for i in range(start, args.steps):
         prof.step(i)
-        state, metrics = step(state, shard_batch(next(data), mesh))
+        state, metrics = step(state, next(data))
         if i % 10 == 0:
             print(f"step {i} loss {float(metrics['loss']):.4f}", flush=True)
         if mgr is not None and (i + 1) % args.checkpoint_every == 0:

@@ -48,7 +48,6 @@ def main(argv=None) -> int:
     from ..train.step import (
         classification_loss_fn,
         make_train_step,
-        shard_batch,
         shard_train_state,
     )
 
@@ -66,14 +65,17 @@ def main(argv=None) -> int:
                                model_kwargs={"train": True}),
         has_batch_stats=True,
     )
-    data = images_or_fallback(args.batch, args.image_size, args.num_classes)
+    from ..train.data import prefetch_to_device
+
+    raw = images_or_fallback(args.batch, args.image_size, args.num_classes)
+    data = prefetch_to_device(
+        ({**b, "x": b["x"].astype("bfloat16")} for b in raw), mesh
+    )
     prof = ProfileCapture.from_args(args)
     t_start = time.time()
     for i in range(args.steps):
         prof.step(i)
-        batch = next(data)
-        batch["x"] = batch["x"].astype("bfloat16")
-        state, metrics = step(state, shard_batch(batch, mesh))
+        state, metrics = step(state, next(data))
         if i % args.log_every == 0:
             print(f"step {i} loss {float(metrics['loss']):.4f}", flush=True)
     prof.close()
