@@ -1,0 +1,112 @@
+"""Autoregressive generation with a KV cache.
+
+The reference operator has no inference story (SURVEY.md: it schedules
+training processes); this framework owns the model zoo, so it ships the
+decode path: one prefill pass over the prompt fills the per-layer K/V
+caches ('cache' collection, transformer.SelfAttention._decode_attend),
+then each new token is ONE compiled T=1 step — static shapes, cache
+updated in place via dynamic_update_slice, no O(T²) prefix recompute.
+Greedy (temperature=0) or temperature sampling.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import TransformerConfig, TransformerLM
+
+
+def _decode_variant(cfg: TransformerConfig) -> TransformerConfig:
+    """The decode twin of a training config: same architecture/params,
+    cache-backed attention, no flash/ring (a decode step is a GEMV —
+    the O(T²) kernels have nothing to fuse)."""
+    return dataclasses.replace(cfg, decode=True, use_flash=False, mesh=None)
+
+
+def _fresh_cache(model: TransformerLM, batch: int):
+    """All-zero cache pytree (zero index == empty) with the right shapes,
+    discovered via eval_shape so no device work happens."""
+    shapes = jax.eval_shape(
+        lambda: model.init(
+            jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32)
+        )
+    )["cache"]
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _decode_fns(cfg: TransformerConfig, temperature: float):
+    """Jitted (prefill, step) pair for a decode config, cached so repeated
+    generate() calls with the same shapes reuse the compiled executables
+    (fresh per-call jit closures would recompile every time)."""
+    model = TransformerLM(cfg)
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits.astype(jnp.float32) / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    @jax.jit
+    def prefill(params, cache, prompt, key):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, prompt, mutable=["cache"]
+        )
+        return sample(logits[:, -1], key), mut["cache"]
+
+    @jax.jit
+    def step(params, cache, tok, key):
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            mutable=["cache"],
+        )
+        return sample(logits[:, -1], key), mut["cache"]
+
+    return model, prefill, step
+
+
+def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
+             temperature: float = 0.0, rng: Optional[jax.Array] = None):
+    """Generate `max_new_tokens` continuations of `prompt` [B, P] (int32).
+
+    Returns [B, P + max_new_tokens].  Deterministic greedy decoding at
+    temperature 0; otherwise categorical sampling at the given temperature
+    (requires `rng`).
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > cfg.max_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds max_len {cfg.max_len}"
+        )
+    if temperature > 0.0 and rng is None:
+        raise ValueError("sampling (temperature > 0) needs an rng key")
+
+    model, prefill, step = _decode_fns(
+        _decode_variant(cfg), float(temperature))
+    cache = _fresh_cache(model, batch)
+
+    keys = (
+        jax.random.split(rng, max_new_tokens)
+        if rng is not None
+        else [None] * max_new_tokens
+    )
+    tok, cache = prefill(params, cache, prompt, keys[0])
+    out = [tok]
+    for i in range(1, max_new_tokens):
+        tok, cache = step(params, cache, tok, keys[i])
+        out.append(tok)
+    return jnp.concatenate([prompt, jnp.stack(out, axis=1)], axis=1)

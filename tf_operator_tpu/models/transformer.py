@@ -19,8 +19,9 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-from ..ops.attention import flash_attention, xla_attention
+from ..ops.attention import NEG_INF, flash_attention, xla_attention
 from ..parallel.ring_attention import ring_attention
 
 
@@ -43,6 +44,11 @@ class TransformerConfig:
     # False forces the O(T²) XLA attention path even on TPU — the bench's
     # baseline arm (flash vs XLA is the framework's own headline comparison).
     use_flash: bool = True
+    # Autoregressive decoding: attention keeps a K/V cache ('cache'
+    # collection) of max_len positions and each __call__ appends its T
+    # tokens at the running cache index — one compiled T=1 step per new
+    # token, no O(T²) prefix recompute (models/generate.py drives it).
+    decode: bool = False
     # Modern-LM (llama-family) knobs: grouped-query attention (num_kv_heads
     # < num_heads shares each K/V head across a query group), rotary
     # position embeddings (replaces the learned wpe table), RMSNorm, and a
@@ -136,28 +142,81 @@ class SelfAttention(nn.Module):
         v = dense("value", kv_heads)(x)
         # [B, T, H, D] -> [B, H, T, D]
         q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
-        if cfg.use_rope:
-            q = rope(q, theta=cfg.rope_theta)
-            k = rope(k, theta=cfg.rope_theta)
-        # The flash and ring paths consume grouped k/v natively (no repeat
-        # in HBM; ops/attention.py maps query heads to KV heads in-kernel,
-        # and ring hops move the grouped blocks over ICI).  Only the plain
-        # XLA path needs the explicit widen.
-        if _use_ring(cfg):
-            out = ring_attention(
-                q, k, v, cfg.mesh, axis_name=cfg.ring_axis, causal=cfg.causal
-            )
-        elif cfg.use_flash:
-            out = flash_attention(q, k, v, cfg.causal)
+        if cfg.decode:
+            out = self._decode_attend(q, k, v)
         else:
-            from ..ops.attention import repeat_kv
+            if cfg.use_rope:
+                q = rope(q, theta=cfg.rope_theta)
+                k = rope(k, theta=cfg.rope_theta)
+            # The flash and ring paths consume grouped k/v natively (no
+            # repeat in HBM; ops/attention.py maps query heads to KV heads
+            # in-kernel, and ring hops move the grouped blocks over ICI).
+            # Only the plain XLA path needs the explicit widen.
+            if _use_ring(cfg):
+                out = ring_attention(
+                    q, k, v, cfg.mesh, axis_name=cfg.ring_axis,
+                    causal=cfg.causal,
+                )
+            elif cfg.use_flash:
+                out = flash_attention(q, k, v, cfg.causal)
+            else:
+                from ..ops.attention import repeat_kv
 
-            out = xla_attention(q, *repeat_kv(q, k, v), causal=cfg.causal)
+                out = xla_attention(q, *repeat_kv(q, k, v), causal=cfg.causal)
         out = out.transpose(0, 2, 1, 3)  # [B, T, H, D]
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out",
             kernel_init=nn.initializers.normal(0.02),
         )(out)
+
+    def _decode_attend(self, q, k, v):
+        """KV-cached attention for autoregressive decoding.
+
+        Appends this call's T tokens of k/v at the running cache index and
+        attends q against the whole cache with the absolute causal mask, so
+        a prefill (T = prompt) and subsequent T=1 steps share one code
+        path.  RoPE rotates by absolute positions (cache index + row).
+        Grouped KV stays grouped in the cache; the widen happens on the
+        tiny per-step score computation only.
+        """
+        cfg = self.cfg
+        batch, _, t, head_dim = q.shape
+        kv_heads = k.shape[1]
+        cache_k = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (batch, kv_heads, cfg.max_len, head_dim), cfg.dtype)
+        cache_v = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (batch, kv_heads, cfg.max_len, head_dim), cfg.dtype)
+        cache_i = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+        pos0 = cache_i.value
+        if cfg.use_rope:
+            positions = pos0 + jnp.arange(t)
+            q = rope(q, theta=cfg.rope_theta, positions=positions)
+            k = rope(k, theta=cfg.rope_theta, positions=positions)
+        kf = lax.dynamic_update_slice(cache_k.value, k.astype(cfg.dtype),
+                                      (0, 0, pos0, 0))
+        vf = lax.dynamic_update_slice(cache_v.value, v.astype(cfg.dtype),
+                                      (0, 0, pos0, 0))
+        cache_k.value, cache_v.value = kf, vf
+        cache_i.value = pos0 + t
+
+        from ..ops.attention import repeat_kv
+
+        kf, vf = repeat_kv(q, kf, vf)
+        scale = head_dim ** -0.5
+        logits = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, kf, preferred_element_type=jnp.float32
+        ) * scale
+        # absolute causal mask: query row r sits at pos0+r; cache cols
+        # beyond it (incl. the unfilled zero slots) are masked off
+        q_pos = pos0 + lax.broadcasted_iota(jnp.int32, (t, cfg.max_len), 0)
+        k_pos = lax.broadcasted_iota(jnp.int32, (t, cfg.max_len), 1)
+        logits = jnp.where(k_pos[None, None] <= q_pos[None, None],
+                           logits, NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1).astype(vf.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, vf).astype(q.dtype)
 
 
 class MLP(nn.Module):
@@ -222,7 +281,16 @@ class TransformerLM(nn.Module):
             pos_emb = self.param(
                 "wpe", nn.initializers.normal(0.02), (cfg.max_len, cfg.d_model)
             )
-            x = x + pos_emb[None, :t, :]
+            if cfg.decode:
+                # absolute positions continue from the decode cache
+                idx = self.variable(
+                    "cache", "wpe_index", lambda: jnp.zeros((), jnp.int32))
+                off = idx.value
+                idx.value = off + t
+                x = x + lax.dynamic_slice(
+                    pos_emb, (off, 0), (t, cfg.d_model))[None]
+            else:
+                x = x + pos_emb[None, :t, :]
         x = x.astype(cfg.dtype)
         block = Block
         if cfg.remat:
