@@ -230,6 +230,20 @@ class TestGenerate:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
         assert a.shape == (2, 9)
 
+    def test_top_k_one_equals_greedy(self):
+        """top_k=1 sampling collapses to the argmax path regardless of
+        temperature — a free oracle for the masking logic."""
+        from tf_operator_tpu.models.generate import generate
+
+        cfg = self._cfg("gpt")
+        model = TransformerLM(cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(0), (2, 4), 0, 64)
+        params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+        greedy = generate(cfg, params, prompt, 5)
+        topk1 = generate(cfg, params, prompt, 5, temperature=2.0, top_k=1,
+                         rng=jax.random.PRNGKey(3))
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(topk1))
+
     def test_rejects_overlong_and_missing_rng(self):
         from tf_operator_tpu.models.generate import generate
 

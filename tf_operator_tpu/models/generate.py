@@ -41,7 +41,7 @@ def _fresh_cache(model: TransformerLM, batch: int):
 
 
 @functools.lru_cache(maxsize=32)
-def _decode_fns(cfg: TransformerConfig, temperature: float):
+def _decode_fns(cfg: TransformerConfig, temperature: float, top_k: int):
     """Jitted (prefill, step) pair for a decode config, cached so repeated
     generate() calls with the same shapes reuse the compiled executables
     (fresh per-call jit closures would recompile every time)."""
@@ -50,18 +50,26 @@ def _decode_fns(cfg: TransformerConfig, temperature: float):
     def sample(logits, key):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits.astype(jnp.float32)
+        if top_k:
+            # keep the top_k logits per row, mask the rest
+            kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
         return jax.random.categorical(
-            key, logits.astype(jnp.float32) / temperature, axis=-1
+            key, logits / temperature, axis=-1
         ).astype(jnp.int32)
 
-    @jax.jit
+    # The cache is donated: XLA aliases it input->output, so each step's
+    # dynamic_update_slice really is in place — without donation every
+    # token would copy the whole per-layer KV cache.
+    @functools.partial(jax.jit, donate_argnums=(1,))
     def prefill(params, cache, prompt, key):
         logits, mut = model.apply(
             {"params": params, "cache": cache}, prompt, mutable=["cache"]
         )
         return sample(logits[:, -1], key), mut["cache"]
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(1,))
     def step(params, cache, tok, key):
         logits, mut = model.apply(
             {"params": params, "cache": cache}, tok[:, None],
@@ -73,17 +81,22 @@ def _decode_fns(cfg: TransformerConfig, temperature: float):
 
 
 def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
-             temperature: float = 0.0, rng: Optional[jax.Array] = None):
+             temperature: float = 0.0, top_k: int = 0,
+             rng: Optional[jax.Array] = None):
     """Generate `max_new_tokens` continuations of `prompt` [B, P] (int32).
 
     Returns [B, P + max_new_tokens].  Deterministic greedy decoding at
     temperature 0; otherwise categorical sampling at the given temperature
-    (requires `rng`).
+    (requires `rng`), optionally restricted to the `top_k` most likely
+    tokens (0 = no restriction).
     """
     if max_new_tokens < 1:
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0 or top_k > cfg.vocab_size:
+        raise ValueError(
+            f"top_k must be in [0, vocab_size {cfg.vocab_size}], got {top_k}")
     prompt = jnp.asarray(prompt, jnp.int32)
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
@@ -96,7 +109,7 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
         raise ValueError("sampling (temperature > 0) needs an rng key")
 
     model, prefill, step = _decode_fns(
-        _decode_variant(cfg), float(temperature))
+        _decode_variant(cfg), float(temperature), int(top_k))
     cache = _fresh_cache(model, batch)
 
     keys = (

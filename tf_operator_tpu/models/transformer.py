@@ -300,6 +300,10 @@ class TransformerLM(nn.Module):
                 cfg.moe_num_experts > 0 and (i + 1) % cfg.moe_every == 0
             )
             x = block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
+        if cfg.decode:
+            # generation consumes only the last position's logits; skip the
+            # T x vocab readout for the rest of a prefill chunk
+            x = x[:, -1:, :]
         x = _norm(cfg, "ln_f")(x)
         # Weight-tied readout keeps the big vocab matmul on the MXU in bf16.
         logits = emb.attend(x.astype(cfg.dtype))
