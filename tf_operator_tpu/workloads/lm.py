@@ -45,6 +45,9 @@ def main(argv=None) -> int:
                              "llama: RoPE + RMSNorm + SwiGLU + GQA")
     parser.add_argument("--kv-heads", type=int, default=0,
                         help="GQA KV heads for --arch llama (0 = heads/3)")
+    parser.add_argument("--sample-tokens", type=int, default=0,
+                        help="after training, greedily generate this many "
+                             "tokens with the KV-cache decode path")
     args = parser.parse_args(argv)
 
     from .runner import ProfileCapture, WorkloadContext, apply_forced_platform
@@ -54,6 +57,15 @@ def main(argv=None) -> int:
     if args.grad_accum < 1 or args.batch % args.grad_accum:
         print(f"--grad-accum {args.grad_accum} must be >= 1 and divide "
               f"--batch {args.batch}", flush=True)
+        return 2
+    SAMPLE_PROMPT_LEN = 8
+    if args.sample_tokens > 0 and (
+        SAMPLE_PROMPT_LEN + args.sample_tokens > args.seq_len
+    ):
+        # honored or rejected, never silently clamped
+        print(f"--sample-tokens {args.sample_tokens} needs prompt "
+              f"({SAMPLE_PROMPT_LEN}) + tokens <= --seq-len {args.seq_len}",
+              flush=True)
         return 2
 
     ctx = WorkloadContext.from_env()
@@ -182,6 +194,23 @@ def main(argv=None) -> int:
     if mgr is not None:
         mgr.save(state)
         mgr.close()
+    if args.sample_tokens > 0 and ctx.num_processes > 1:
+        # sharded params span other hosts; a bare device_get can't gather
+        # them, and every process would sample redundantly anyway
+        print("sampling skipped on multi-host runs", flush=True)
+    elif args.sample_tokens > 0:
+        # train -> generate demo: greedy KV-cache decode on the learned
+        # bigram structure (params pulled to host: decode runs unsharded)
+        from ..models.generate import generate
+
+        params = jax.device_get(state.params)
+        prompt = jnp.asarray(
+            next(synthetic_tokens(1, SAMPLE_PROMPT_LEN + 1, args.vocab))
+            ["tokens"][:, :SAMPLE_PROMPT_LEN],
+            jnp.int32,
+        )
+        out = generate(cfg, params, prompt, args.sample_tokens)
+        print(f"sample: {out[0].tolist()}", flush=True)
     print("done", flush=True)
     return 0
 
