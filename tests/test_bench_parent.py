@@ -1,0 +1,112 @@
+"""Bench-parent orchestration logic, deterministically.
+
+The bench's resilience behavior (batch ladder, partial results, liveness
+reprobes) exists for a tunnel that wedges mid-run — conditions that can't
+be reproduced on demand.  These tests script child outcomes by
+monkeypatching bench._run, pinning the decision logic the hardware
+artifacts depend on.
+"""
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+bench = importlib.util.module_from_spec(spec)
+sys.modules["bench"] = bench
+spec.loader.exec_module(bench)
+
+
+def run_script(monkeypatch, outcomes):
+    """Patch bench._run to pop scripted (rc, stdout) pairs per invocation;
+    returns the call log."""
+    calls = []
+
+    def fake_run(cmd, env_extra, timeout):
+        tag = next((a for a in cmd if str(a).startswith("--child")), "probe")
+        rc, out = outcomes.pop(0)
+        calls.append((tag, env_extra.get("BENCH_BATCH"), rc))
+        return rc, out, ""
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    return calls
+
+
+def _json(d):
+    return json.dumps(d) + "\n"
+
+
+def test_ladder_steps_down_after_timeout_with_partial(monkeypatch):
+    """A timed-out child that emitted a partial must not stop the ladder:
+    the next rung runs, and its complete result wins."""
+    partial = _json({"metric": "m", "value": 1.0, "unit": "u",
+                     "vs_baseline": None, "partial": "bare arm not measured"})
+    complete = _json({"metric": "m", "value": 2.0, "unit": "u",
+                      "vs_baseline": 0.99})
+    outcomes = [
+        (-9, partial),       # batch 128: timeout after partial
+        (0, "PROBE_OK tpu 1\n"),   # liveness reprobe -> alive
+        (0, complete),       # batch 32: completes
+    ]
+    calls = run_script(monkeypatch, outcomes)
+    stages = []
+    result = bench._throughput("tpu", stages, "resnet")
+    assert result["vs_baseline"] == 0.99
+    assert [c[1] for c in calls if c[0] == "--child-throughput"] == ["128", "32"]
+
+
+def test_dead_tunnel_aborts_ladder_and_returns_partial(monkeypatch):
+    """Timeout + dead reprobe: remaining rungs are skipped and the flagged
+    partial is returned rather than nothing."""
+    partial = _json({"metric": "m", "value": 1.0, "unit": "u",
+                     "vs_baseline": None, "partial": "bare arm not measured"})
+    outcomes = [
+        (-9, partial),   # batch 128: timeout after partial
+        (-9, ""),        # reprobe: dead
+    ]
+    calls = run_script(monkeypatch, outcomes)
+    stages = []
+    result = bench._throughput("tpu", stages, "resnet")
+    assert result["partial_rc"] == -9 and result["vs_baseline"] is None
+    assert len([c for c in calls if c[0] == "--child-throughput"]) == 1
+
+
+def test_crashed_child_with_partial_steps_down(monkeypatch):
+    """A crash (rc != 0, != -9) after the partial emission also steps the
+    ladder instead of returning the partial as complete."""
+    partial = _json({"metric": "m", "value": 1.0, "unit": "u",
+                     "vs_baseline": None, "partial": "bare arm not measured"})
+    complete = _json({"metric": "m", "value": 2.0, "unit": "u",
+                      "vs_baseline": 1.01})
+    outcomes = [
+        (1, partial),    # batch 128: crash (no reprobe for non-timeout)
+        (0, complete),   # batch 32
+    ]
+    run_script(monkeypatch, outcomes)
+    stages = []
+    result = bench._throughput("tpu", stages, "resnet")
+    assert result["vs_baseline"] == 1.01
+
+
+def test_attention_timeout_marks_partial(monkeypatch):
+    rows = _json({"fwd_bwd": [{"seq": 1024, "flash_ms": 1.0}],
+                  "shape": {}, "kernel_path": "pallas"})
+    outcomes = [(-9, rows)]
+    run_script(monkeypatch, outcomes)
+    stages = []
+    result = bench._attention_ladder("tpu", stages)
+    assert result["partial_rc"] == -9
+    assert "partial" in result
+
+
+def test_cpu_fallback_single_rung(monkeypatch):
+    """platform None: fixed small-shape env, exactly one rung."""
+    complete = _json({"metric": "m", "value": 3.0, "unit": "u",
+                      "vs_baseline": 1.0})
+    outcomes = [(0, complete)]
+    calls = run_script(monkeypatch, outcomes)
+    stages = []
+    result = bench._throughput(None, stages, "resnet")
+    assert result["platform"] == "cpu"
+    assert len(calls) == 1
