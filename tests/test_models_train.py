@@ -257,31 +257,47 @@ class TestGenerate:
             generate(cfg, params, prompt, 2, temperature=1.0)
 
 
+from tf_operator_tpu.ops.attention import _on_tpu  # noqa: E402
+
+
 @pytest.mark.tpu
+@pytest.mark.skipif(not _on_tpu(), reason="needs a real TPU backend")
 def test_generate_compiled_on_tpu():
-    """Hardware tier: the KV-cache decode path (dynamic_update_slice cache,
-    donated buffers, absolute-position mask) compiled on the chip matches
-    the uncached full forward token for token."""
-    from tf_operator_tpu.models.generate import generate
+    """Hardware tier: the bf16 KV-cache decode path (dynamic_update_slice
+    cache, donated buffers, absolute-position mask) compiled on the chip
+    matches an f32 uncached reference within bf16 tolerances.  The
+    comparison is teacher-forced on the reference's tokens so a near-tie
+    argmax flip (pure bf16 rounding) can't cascade — the same oracle style
+    as tests/test_ops.py::TestCompiledOnTPU."""
+    import dataclasses
+
+    from tf_operator_tpu.models.generate import _fresh_cache
     from tf_operator_tpu.models.transformer import llama_style_config
 
     cfg = llama_style_config(
         vocab_size=256, num_layers=2, num_heads=4, num_kv_heads=2,
         d_model=128, d_ff=256, max_len=64, dtype=jnp.bfloat16)
-    model = TransformerLM(cfg)
+    dmodel = TransformerLM(
+        dataclasses.replace(cfg, decode=True, use_flash=False, mesh=None))
+    ref_model = TransformerLM(
+        dataclasses.replace(cfg, use_flash=False, dtype=jnp.float32))
     prompt = jax.random.randint(jax.random.PRNGKey(0), (2, 8), 0, 256)
-    params = model.init(jax.random.PRNGKey(1), prompt)["params"]
-    out = generate(cfg, params, prompt, max_new_tokens=8)
+    params = TransformerLM(cfg).init(jax.random.PRNGKey(1), prompt)["params"]
 
+    cache = _fresh_cache(dmodel, 2)
     seq = prompt
-    import dataclasses
-
-    uncached = TransformerLM(dataclasses.replace(cfg, use_flash=False))
-    for _ in range(8):
-        logits = uncached.apply({"params": params}, seq)
-        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    logits_d, mut = dmodel.apply(
+        {"params": params, "cache": cache}, prompt, mutable=["cache"])
+    for _ in range(6):
+        ref_logits = ref_model.apply({"params": params}, seq)[:, -1]
+        np.testing.assert_allclose(
+            np.asarray(logits_d[:, -1], np.float32), np.asarray(ref_logits),
+            atol=0.25, rtol=0.05)
+        nxt = jnp.argmax(ref_logits, axis=-1).astype(jnp.int32)
         seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
-    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+        logits_d, mut = dmodel.apply(
+            {"params": params, "cache": mut["cache"]}, nxt[:, None],
+            mutable=["cache"])
 
 
 def test_prefetch_to_device_preserves_stream():

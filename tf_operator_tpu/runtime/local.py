@@ -15,6 +15,7 @@ ref service naming, vendor/.../common/service.go:303-317).
 """
 from __future__ import annotations
 
+import itertools
 import os
 import signal
 import subprocess
@@ -29,6 +30,9 @@ from ..api.types import ReplicaType, TPUJob
 from ..utils import logging as tpulog
 from .cluster import EventType, InMemoryCluster
 
+# per-process cluster counter; feeds the default port-range spreading
+_CLUSTER_SEQ = itertools.count()
+
 log = tpulog.logger_for_key("local-cluster")
 
 
@@ -40,13 +44,16 @@ class LocalProcessCluster(InMemoryCluster):
         self.workdir = Path(workdir or ".tpujob-local")
         self.workdir.mkdir(parents=True, exist_ok=True)
         if base_port is None:
-            # Spread the default range by PID: two clusters in different
-            # processes (e.g. concurrent pytest runs) must not hand the
-            # same 127.0.0.1 port to different jobs' coordinators — the
-            # colliding groups rendezvous across tests and wedge.
+            # Spread the default range by PID and per-process instance:
+            # two clusters in different processes (concurrent pytest runs)
+            # or sequential clusters in one process (a killed predecessor's
+            # sockets may not be reaped yet) must not hand the same
+            # 127.0.0.1 port to different jobs' coordinators — colliding
+            # groups rendezvous across tests and wedge.
             # range stays below Linux's ephemeral ports (32768+) so no
             # kernel-assigned outgoing connection can squat a replica port
-            base_port = 20000 + (os.getpid() * 2654435761 >> 8) % 12000
+            seed = os.getpid() * 2654435761 ^ next(_CLUSTER_SEQ) * 0x9E3779B9
+            base_port = 20000 + (seed >> 8) % 12000
         self.base_port = base_port
         self.extra_env = dict(extra_env or {})
         self._procs: Dict[Tuple[str, str], subprocess.Popen] = {}
