@@ -38,9 +38,19 @@ def test_multislice_document_consumed_by_real_processes(tmp_path):
     controller.start()
     client = TPUJobClient(cluster)
     try:
+        # AllWorkers: the job succeeds only when every replica's fabric
+        # check passed.  With the default worker-0 rule, worker-0 finishing
+        # flips the job Succeeded and CleanPodPolicy=Running deletes the
+        # still-running peers before they log their OK (a real race this
+        # test hit under load — correct operator behavior, wrong policy
+        # for an all-replicas assertion).
+        from tf_operator_tpu.api.types import SuccessPolicy
+
         job = TPUJob(
             metadata=ObjectMeta(name="mslice"),
-            spec=TPUJobSpec(replica_specs={
+            spec=TPUJobSpec(
+                success_policy=SuccessPolicy.ALL_WORKERS,
+                replica_specs={
                 ReplicaType.WORKER: ReplicaSpec(
                     replicas=4,
                     # v5litepod-8 / 2x4 = 8 chips over 2 hosts -> 4 replicas
@@ -57,9 +67,8 @@ def test_multislice_document_consumed_by_real_processes(tmp_path):
         client.create(job)
         client.wait_for_job("mslice", timeout=180)
         assert client.is_job_succeeded("mslice")
-        # The default success policy fires on worker-0 completion, so the
-        # other replicas may still be flushing their last log line — poll
-        # until every replica's OK marker lands (or the deadline trips).
+        # all four succeeded (AllWorkers), but the last log line may still
+        # be flushing — poll briefly for every replica's OK marker
         import time as _time
 
         deadline = _time.time() + 30
