@@ -32,6 +32,11 @@ class FakeApiServer:
         self._store: Dict[Tuple[str, str], Dict[str, dict]] = {}
         self._rv = 0
         self._watchers: List[Tuple[str, "queue.Queue"]] = []
+        # bounded (rv, kind, event) log: a watch with ?resourceVersion=N
+        # replays events N < rv before streaming, like the real apiserver —
+        # without it, anything created between a client's LIST and its
+        # watch-stream registration is silently lost
+        self._event_log: List[Tuple[int, str, dict]] = []
         self.block_evictions = False
         self.requests: List[Tuple[str, str]] = []  # (method, path) log
 
@@ -98,7 +103,16 @@ class FakeApiServer:
 
             def _serve_watch(self, kind, ns, params):
                 q: "queue.Queue" = queue.Queue()
+                try:
+                    from_rv = int(params.get("resourceVersion") or 0)
+                except ValueError:
+                    from_rv = 0
                 with server._lock:
+                    # backlog replay + registration are atomic: no event can
+                    # land between them
+                    for erv, ekind, evt in server._event_log:
+                        if ekind == kind and erv > from_rv:
+                            q.put(evt)
                     server._watchers.append((kind, q))
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -237,9 +251,13 @@ class FakeApiServer:
             self._notify(kind, "DELETED", obj)
 
     def _notify(self, kind: str, etype: str, obj: dict) -> None:
-        for wkind, q in list(self._watchers):
-            if wkind == kind:
-                q.put({"type": etype, "object": obj})
+        evt = {"type": etype, "object": obj}
+        with self._lock:
+            self._event_log.append((self._rv, kind, evt))
+            del self._event_log[:-1000]
+            watchers = [q for wkind, q in self._watchers if wkind == kind]
+        for q in watchers:
+            q.put(evt)
 
     # -- lifecycle / test hooks --
 
