@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Opportunistic hardware-evidence watcher.
+
+The tunneled TPU backend is flaky (see artifacts/ROUND3_NOTES.md: a wedge
+can last hours, with occasional ~1-minute live windows).  This watcher
+loops: probe the backend in a subprocess (a wedged tunnel hangs `import
+jax` itself, so the probe must be a killable child), and when it is live,
+burn down the pending hardware-evidence list in priority order:
+
+  1. full bench with the LM model first (LM tokens/sec + MFU, then the
+     second model, then the flash-vs-XLA attention ladder) -> bench JSON
+  2. GQA compiled kernel tests (`pytest -m tpu -k gqa`)
+  3. the full TPU test tier (`pytest -m tpu`)
+
+Every capture goes to a temp file first and only replaces the artifact
+when the capture is non-empty and (for the bench) parses as JSON — a
+mid-run wedge must never truncate previously recorded evidence.  Partial
+bench runs (stage timeouts flagged via `partial_rc` by bench.py) are kept
+under a `_partial` name and the stage is retried on the next live window.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ART = os.path.join(ROOT, "artifacts")
+STAMP = sys.argv[1] if len(sys.argv) > 1 else "r04"
+MAX_SECONDS = float(os.environ.get("HW_WATCHER_MAX_SECONDS", 11.0 * 3600))
+PROBE_INTERVAL = float(os.environ.get("HW_WATCHER_PROBE_INTERVAL", 60))
+
+BENCH = os.path.join(ART, f"bench_{STAMP}.json")
+GQA = os.path.join(ART, f"gqa_tpu_{STAMP}.log")
+TIER = os.path.join(ART, f"tpu_tier_{STAMP}.log")
+
+
+def log(msg: str) -> None:
+    print(f"[{time.strftime('%H:%M:%S', time.gmtime())}] {msg}", flush=True)
+
+
+def run(cmd, timeout, env=None):
+    """Run cmd, return (rc, combined output); rc=None on timeout."""
+    full_env = dict(os.environ)
+    if env:
+        full_env.update(env)
+    try:
+        r = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=timeout,
+            env=full_env, cwd=ROOT)
+        return r.returncode, (r.stdout or "") + (r.stderr or "")
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"")
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        return None, out
+
+
+def probe() -> bool:
+    rc, out = run(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform)"],
+        timeout=90)
+    return rc == 0 and "tpu" in out.lower()
+
+
+def bench_complete(path: str) -> bool:
+    """A bench capture counts as done only if it ran on TPU, produced a
+    nonzero headline, and no stage was cut short by a tunnel wedge."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return False
+    stages = doc.get("stages", [])
+    on_tpu = any(s.get("stage") == "probe" and s.get("ok")
+                 and "tpu" in str(s.get("platform", "")).lower()
+                 for s in stages)
+    partial = any(s.get("partial_rc") or s.get("rc") is None
+                  or s.get("skipped") for s in stages
+                  if str(s.get("stage", "")).startswith(
+                      ("throughput", "attention")))
+    return on_tpu and doc.get("value", 0) > 0 and not partial
+
+
+def do_bench() -> bool:
+    log("stage bench: starting (BENCH_MODEL=lm first)")
+    rc, out = run([sys.executable, "bench.py"], timeout=3900,
+                  env={"BENCH_MODEL": "lm"})
+    lines = [ln for ln in out.strip().splitlines() if ln.strip()]
+    if not lines:
+        log(f"stage bench: no output (rc={rc})")
+        return False
+    tmp = os.path.join(ART, ".bench_watch.tmp")
+    with open(tmp, "w") as f:
+        f.write(lines[-1] + "\n")
+    if bench_complete(tmp):
+        os.replace(tmp, BENCH)
+        log(f"stage bench: COMPLETE -> {BENCH}")
+        return True
+    # keep flagged partials under a distinct name; retry next window
+    try:
+        json.loads(lines[-1])
+    except ValueError:
+        log(f"stage bench: last line not JSON (rc={rc}); dropped")
+        os.unlink(tmp)
+        return False
+    n = 1
+    while os.path.exists(os.path.join(
+            ART, f"bench_{STAMP}_partial{n}.json")):
+        n += 1
+    dst = os.path.join(ART, f"bench_{STAMP}_partial{n}.json")
+    os.replace(tmp, dst)
+    log(f"stage bench: partial -> {dst}; will retry")
+    return False
+
+
+def do_pytest(expr, timeout, dest, label) -> bool:
+    log(f"stage {label}: starting")
+    cmd = [sys.executable, "-m", "pytest", "tests/", "-m", "tpu", "-v"]
+    if expr:
+        cmd += ["-k", expr]
+    rc, out = run(cmd, timeout=timeout, env={"TPUJOB_TEST_PLATFORM": "tpu"})
+    tail = "\n".join(out.strip().splitlines()[-40:])
+    if rc == 0 and "passed" in tail and tail.strip():
+        tmp = dest + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(tail + "\n")
+        os.replace(tmp, dest)
+        log(f"stage {label}: COMPLETE -> {dest}")
+        return True
+    log(f"stage {label}: failed (rc={rc}); tail: {tail[-300:]!r}")
+    return False
+
+
+def main() -> None:
+    os.makedirs(ART, exist_ok=True)
+    start = time.time()
+    log(f"watcher up, stamp={STAMP}, budget={MAX_SECONDS / 3600:.1f}h")
+    while time.time() - start < MAX_SECONDS:
+        pending = [p for p in (BENCH, GQA, TIER) if not os.path.exists(p)]
+        if not pending:
+            log("ALL_DONE: every artifact recorded")
+            return
+        if probe():
+            log(f"tunnel LIVE; pending: {[os.path.basename(p) for p in pending]}")
+            if not os.path.exists(BENCH):
+                do_bench()
+            if not os.path.exists(GQA) and probe():
+                do_pytest("gqa", 1200, GQA, "gqa")
+            if not os.path.exists(TIER) and probe():
+                do_pytest(None, 1800, TIER, "tier")
+        else:
+            log("tunnel dead")
+        time.sleep(PROBE_INTERVAL)
+    log("budget exhausted; exiting")
+
+
+if __name__ == "__main__":
+    main()

@@ -21,7 +21,7 @@ from urllib.parse import parse_qs, urlsplit
 _COLLECTION_RE = re.compile(
     r"^/(?:api/v1|apis/(?P<group>[^/]+/[^/]+))"
     r"(?:/namespaces/(?P<ns>[^/]+))?/(?P<kind>[a-z]+)"
-    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status|eviction|log))?$"
+    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status|eviction|log|binding))?$"
 )
 
 
@@ -150,6 +150,23 @@ class FakeApiServer:
                     with server._lock:
                         server._delete(kind, ns, name)
                     return self._reply(200, {"kind": "Status", "code": 200})
+                if sub == "binding":
+                    # pods/binding subresource: the scheduler's node
+                    # assignment.  Sets spec.nodeName exactly once (409 on a
+                    # second binding, like the real apiserver).
+                    target = (body.get("target") or {}).get("name", "")
+                    if not target:
+                        return self._error(400, "binding has no target.name")
+                    with server._lock:
+                        pod = server._get(kind, ns, name)
+                        if pod is None:
+                            return self._error(404, f"{kind} {ns}/{name} not found")
+                        if (pod.get("spec") or {}).get("nodeName"):
+                            return self._error(
+                                409, f"pod {name} is already assigned to a node")
+                        pod.setdefault("spec", {})["nodeName"] = target
+                        server._put(kind, ns, name, pod)
+                    return self._reply(201, {"kind": "Status", "code": 201})
                 with server._lock:
                     obj_name = (body.get("metadata") or {}).get("name", "")
                     if server._get(kind, ns, obj_name) is not None:
@@ -290,6 +307,16 @@ class FakeApiServer:
     def objects(self, kind: str, namespace: str = "default") -> Dict[str, dict]:
         with self._lock:
             return dict(self._store.get((kind, namespace), {}))
+
+    def add_node(self, name: str, labels: Optional[dict] = None,
+                 allocatable: Optional[dict] = None) -> None:
+        """Seed a cluster node (for scheduler/binding tests)."""
+        with self._lock:
+            self._put("nodes", None, name, {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": name, "labels": labels or {}},
+                "status": {"allocatable": allocatable or {}},
+            }, new=True)
 
 
 def _merge_patch(base: dict, patch: dict) -> dict:

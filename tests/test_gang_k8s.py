@@ -1,0 +1,339 @@
+"""Gang scheduling on the Kubernetes backend.
+
+Two deployable paths, both exercised against the fake apiserver:
+
+1. --gang-mechanism volcano: the controller emits the reference's exact gang
+   shapes — a scheduling.volcano.sh/v1beta1 PodGroup with minMember
+   (SyncPodGroup, vendor/.../common/job_controller.go:211-239) and pods with
+   schedulerName "volcano" + the scheduling.k8s.io/group-name annotation
+   (pod.go:43,52-53,472-488) — so a cluster-installed Volcano enforces
+   admission with no in-process scheduler.
+
+2. --gang-mechanism podgroup over --runtime k8s: the operator's own
+   GangScheduler is the gang scheduler.  Pods stamped with its scheduler
+   name are ignored by kube-scheduler and sit unscheduled; once the whole
+   gang is present the scheduler binds every member through the real
+   pods/binding subresource (KubernetesCluster.bind_pod), picking nodes by
+   nodeSelector match and google.com/tpu fit.
+"""
+import time
+
+import pytest
+
+from fake_apiserver import FakeApiServer
+from testutil import new_tpujob
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.core import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodTemplateSpec,
+)
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.runtime.k8s import KubeConfig, KubernetesCluster
+from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+from tf_operator_tpu.runtime.scheduler import GangScheduler
+
+VOLCANO_PODGROUP_PATH = (
+    "/apis/scheduling.volcano.sh/v1beta1/namespaces/default/podgroups"
+)
+
+
+@pytest.fixture()
+def k8s():
+    server = FakeApiServer()
+    url = server.start()
+    cluster = KubernetesCluster(
+        KubeConfig(host=url, namespace="default"), namespace="default"
+    )
+    yield server, cluster
+    cluster.close()
+    server.stop()
+
+
+def _wait(predicate, timeout=15.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# volcano mechanism: exact reference shapes, admission delegated
+
+
+def test_volcano_mechanism_emits_reference_shapes(k8s):
+    server, cluster = k8s
+    controller = TPUJobController(
+        cluster,
+        config=ReconcilerConfig(
+            enable_gang_scheduling=True, gang_mechanism="volcano"
+        ),
+    )
+    job = new_tpujob(worker=2, ps=1, name="vjob")
+    cluster.create_job(job)
+    controller.sync_job("default/vjob")
+
+    # PodGroup posted to the volcano API group with minMember = total replicas
+    assert ("POST", VOLCANO_PODGROUP_PATH) in server.requests
+    groups = server.objects("podgroups")
+    assert list(groups) == ["vjob"]
+    pg = groups["vjob"]
+    assert pg["apiVersion"] == "scheduling.volcano.sh/v1beta1"
+    assert pg["kind"] == "PodGroup"
+    assert pg["spec"]["minMember"] == 3
+    # owner reference ties PodGroup lifetime to the job (GenOwnerReference)
+    assert pg["metadata"]["ownerReferences"][0]["name"] == "vjob"
+
+    # every pod: schedulerName "volcano" + the batch-scheduler annotation,
+    # and NOT the in-process scheduler's shapes
+    pods = server.objects("pods")
+    assert len(pods) == 3
+    for pod in pods.values():
+        assert pod["spec"]["schedulerName"] == "volcano"
+        annotations = pod["metadata"]["annotations"]
+        assert annotations["scheduling.k8s.io/group-name"] == "vjob"
+        assert constants.GANG_GROUP_ANNOTATION not in annotations
+
+
+def test_volcano_mechanism_keeps_user_scheduler(k8s):
+    """(ref: pod.go:474-479 — warn, don't override a user's scheduler)."""
+    server, cluster = k8s
+    controller = TPUJobController(
+        cluster,
+        config=ReconcilerConfig(
+            enable_gang_scheduling=True, gang_mechanism="volcano"
+        ),
+    )
+    job = new_tpujob(worker=1, name="vjob-custom")
+    from tf_operator_tpu.api.types import ReplicaType
+
+    job.spec.replica_specs[ReplicaType.WORKER].template.scheduler_name = (
+        "my-scheduler"
+    )
+    cluster.create_job(job)
+    controller.sync_job("default/vjob-custom")
+
+    pods = server.objects("pods")
+    assert pods["vjob-custom-worker-0"]["spec"]["schedulerName"] == "my-scheduler"
+    events = cluster.list_events(object_name="vjob-custom")
+    assert any(e.reason == "PodTemplateSchedulerName" for e in events)
+
+
+# ---------------------------------------------------------------------------
+# podgroup mechanism over k8s: the operator binds through pods/binding
+
+
+def _gang_pod(name, group, index, tpu=0.0, node_selector=None):
+    resources = {constants.TPU_RESOURCE: tpu} if tpu else {}
+    return Pod(
+        metadata=ObjectMeta(
+            name=name, namespace="default",
+            labels={
+                constants.LABEL_REPLICA_TYPE: "worker",
+                constants.LABEL_REPLICA_INDEX: str(index),
+            },
+            annotations={constants.GANG_GROUP_ANNOTATION: group},
+        ),
+        spec=PodTemplateSpec(
+            containers=[Container(name="tensorflow", image="i",
+                                  resources=resources)],
+            scheduler_name=constants.GANG_SCHEDULER_NAME,
+            node_selector=dict(node_selector or {}),
+        ),
+    )
+
+
+def _node_of(server, pod_name):
+    pod = server.objects("pods").get(pod_name)
+    if pod is None:
+        return None
+    return (pod.get("spec") or {}).get("nodeName")
+
+
+def test_gang_binds_atomically_via_binding_subresource(k8s):
+    server, cluster = k8s
+    server.add_node("tpu-node-0", allocatable={constants.TPU_RESOURCE: "8"})
+    GangScheduler(cluster)
+
+    cluster.create_podgroup(PodGroup(
+        metadata=ObjectMeta(name="g1", namespace="default"), min_member=2,
+    ))
+    cluster.create_pod(_gang_pod("g1-worker-0", "g1", 0, tpu=4.0))
+
+    # half a gang never binds (all-or-nothing admission)
+    time.sleep(1.0)
+    assert not _node_of(server, "g1-worker-0")
+    assert not any(p.endswith("/binding") for _m, p in server.requests)
+
+    cluster.create_pod(_gang_pod("g1-worker-1", "g1", 1, tpu=4.0))
+    assert _wait(lambda: _node_of(server, "g1-worker-0")
+                 and _node_of(server, "g1-worker-1"))
+
+    # the real subresource was used, once per member
+    binding_posts = [p for m, p in server.requests
+                     if m == "POST" and p.endswith("/binding")]
+    assert sorted(binding_posts) == [
+        "/api/v1/namespaces/default/pods/g1-worker-0/binding",
+        "/api/v1/namespaces/default/pods/g1-worker-1/binding",
+    ]
+    assert _node_of(server, "g1-worker-0") == "tpu-node-0"
+    assert _node_of(server, "g1-worker-1") == "tpu-node-0"
+    # admission persisted the PodGroup phase through the wire
+    assert _wait(lambda: server.objects("podgroups")["g1"]
+                 .get("status", {}).get("phase") == "Running")
+
+
+def test_binding_respects_capacity_and_selector(k8s):
+    server, cluster = k8s
+    # node-a: TPU node with room for one 4-chip pod; node-b: bigger TPU node
+    # behind a selector; node-c: CPU-only, must never receive gang pods
+    server.add_node(
+        "node-a",
+        labels={"tpu": "v5e"},
+        allocatable={constants.TPU_RESOURCE: "4"},
+    )
+    server.add_node(
+        "node-b",
+        labels={"tpu": "v5e"},
+        allocatable={constants.TPU_RESOURCE: "8"},
+    )
+    server.add_node("node-c", labels={"cpu": "only"})
+    GangScheduler(cluster)
+
+    cluster.create_podgroup(PodGroup(
+        metadata=ObjectMeta(name="g2", namespace="default"), min_member=2,
+    ))
+    selector = {"tpu": "v5e"}
+    cluster.create_pod(
+        _gang_pod("g2-worker-0", "g2", 0, tpu=4.0, node_selector=selector))
+    cluster.create_pod(
+        _gang_pod("g2-worker-1", "g2", 1, tpu=8.0, node_selector=selector))
+
+    assert _wait(lambda: _node_of(server, "g2-worker-0")
+                 and _node_of(server, "g2-worker-1"))
+    # the 8-chip pod only fits node-b; the 4-chip pod fits node-a
+    assert _node_of(server, "g2-worker-1") == "node-b"
+    assert _node_of(server, "g2-worker-0") == "node-a"
+
+
+def test_unschedulable_pod_gets_warning_event(k8s):
+    server, cluster = k8s
+    server.add_node("small-node", allocatable={constants.TPU_RESOURCE: "2"})
+    GangScheduler(cluster)
+
+    cluster.create_podgroup(PodGroup(
+        metadata=ObjectMeta(name="g3", namespace="default"), min_member=1,
+    ))
+    # chip-capacity pool admits (unlimited by default) but no node fits;
+    # binding fails open with a FailedScheduling event, pod stays unbound
+    cluster.create_pod(_gang_pod("g3-worker-0", "g3", 0, tpu=16.0))
+    assert _wait(lambda: any(
+        e.reason == "FailedScheduling"
+        for e in cluster.list_events(object_name="g3-worker-0")))
+    assert not _node_of(server, "g3-worker-0")
+
+
+def test_no_partial_gang_when_one_member_infeasible(k8s):
+    """If any member has no feasible node, NO member binds — the feasible
+    subset starting alone would be a partial gang."""
+    server, cluster = k8s
+    server.add_node("four-chip", allocatable={constants.TPU_RESOURCE: "4"})
+    sched = GangScheduler(cluster, retry_interval=0.3)
+    try:
+        cluster.create_podgroup(PodGroup(
+            metadata=ObjectMeta(name="g7", namespace="default"), min_member=2,
+        ))
+        cluster.create_pod(_gang_pod("g7-worker-0", "g7", 0, tpu=4.0))
+        cluster.create_pod(_gang_pod("g7-worker-1", "g7", 1, tpu=4.0))
+        assert _wait(lambda: any(
+            e.reason == "FailedScheduling"
+            for e in cluster.list_events(object_name="g7-worker-1")))
+        assert not _node_of(server, "g7-worker-0")
+        assert not _node_of(server, "g7-worker-1")
+        assert not any(p.endswith("/binding") for _m, p in server.requests)
+
+        # a second node makes the whole gang feasible; the sweep binds both
+        server.add_node("four-chip-b",
+                        allocatable={constants.TPU_RESOURCE: "4"})
+        assert _wait(lambda: _node_of(server, "g7-worker-0")
+                     and _node_of(server, "g7-worker-1"))
+    finally:
+        sched.close()
+
+
+def test_retry_binds_after_node_appears(k8s):
+    """Node churn produces no pod watch events; the periodic sweep must pick
+    up a stranded-but-admitted gang once a feasible node exists."""
+    server, cluster = k8s
+    sched = GangScheduler(cluster, retry_interval=0.3)
+    try:
+        cluster.create_podgroup(PodGroup(
+            metadata=ObjectMeta(name="g4", namespace="default"), min_member=1,
+        ))
+        cluster.create_pod(_gang_pod("g4-worker-0", "g4", 0, tpu=4.0))
+        assert _wait(lambda: any(
+            e.reason == "FailedScheduling"
+            for e in cluster.list_events(object_name="g4-worker-0")))
+        assert not _node_of(server, "g4-worker-0")
+
+        server.add_node("late-node",
+                        allocatable={constants.TPU_RESOURCE: "8"})
+        assert _wait(lambda: _node_of(server, "g4-worker-0") == "late-node")
+    finally:
+        sched.close()
+
+
+def test_terminal_pods_release_node_capacity(k8s):
+    """Completed pods keep spec.nodeName forever; counting their chips would
+    permanently starve the node for every later gang."""
+    server, cluster = k8s
+    server.add_node("n0", allocatable={constants.TPU_RESOURCE: "4"})
+    sched = GangScheduler(cluster, retry_interval=0.3)
+    try:
+        cluster.create_podgroup(PodGroup(
+            metadata=ObjectMeta(name="g5", namespace="default"), min_member=1,
+        ))
+        cluster.create_pod(_gang_pod("g5-worker-0", "g5", 0, tpu=4.0))
+        assert _wait(lambda: _node_of(server, "g5-worker-0") == "n0")
+
+        server.set_pod_status("default", "g5-worker-0", {
+            "phase": "Succeeded",
+            "containerStatuses": [
+                {"name": "tensorflow", "state": {"terminated": {"exitCode": 0}}}
+            ],
+        })
+        cluster.create_podgroup(PodGroup(
+            metadata=ObjectMeta(name="g6", namespace="default"), min_member=1,
+        ))
+        cluster.create_pod(_gang_pod("g6-worker-0", "g6", 0, tpu=4.0))
+        assert _wait(lambda: _node_of(server, "g6-worker-0") == "n0")
+    finally:
+        sched.close()
+
+
+def test_controller_gang_pods_bind_end_to_end(k8s):
+    """Full loop: controller creates gang pods + PodGroup from a job; the
+    GangScheduler over the SAME apiserver binds them via pods/binding."""
+    server, cluster = k8s
+    server.add_node("tpu-node-0", allocatable={constants.TPU_RESOURCE: "8"})
+    controller = TPUJobController(
+        cluster,
+        config=ReconcilerConfig(enable_gang_scheduling=True),
+    )
+    GangScheduler(cluster)
+    job = new_tpujob(worker=2, name="gjob")
+    cluster.create_job(job)
+    controller.sync_job("default/gjob")
+
+    assert _wait(lambda: _node_of(server, "gjob-worker-0")
+                 and _node_of(server, "gjob-worker-1"))
+    pods = server.objects("pods")
+    for pod in pods.values():
+        assert pod["spec"]["schedulerName"] == constants.GANG_SCHEDULER_NAME
+        assert (pod["metadata"]["annotations"][constants.GANG_GROUP_ANNOTATION]
+                == "gjob")

@@ -38,6 +38,16 @@ JOB_ROLE_MASTER = "master"
 # (ref: vendor/.../controller.v1/common/pod.go:42-53,472-488)
 GANG_SCHEDULER_NAME = "tpu-gang"
 GANG_GROUP_ANNOTATION = "scheduling.tpu-operator.dev/group-name"
+# The reference's exact gang shapes, used by --gang-mechanism volcano so a
+# Volcano deployment admits our gangs without any in-process scheduler:
+# schedulerName "volcano" (pod.go:43) + the batch-scheduler group annotation
+# (pod.go:52-53) on every gang pod.
+VOLCANO_SCHEDULER_NAME = "volcano"
+VOLCANO_GROUP_ANNOTATION = "scheduling.k8s.io/group-name"
+# Stamped by the substrate once a gang pod has been admitted/started
+# (InMemoryCluster.bind_pod); the k8s backend signals boundness via
+# spec.nodeName instead (pods/binding subresource).
+ANNOTATION_BOUND = "tpu-operator.dev/bound"
 
 # --- Slice allocation annotations (no reference analogue: GPU pods are
 # placed individually; TPU slices are allocated whole).  The reconciler

@@ -106,6 +106,9 @@ class PodTemplateSpec:
     restart_policy: str = ""
     scheduler_name: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
+    # set by the scheduler at binding time (pods/binding subresource on the
+    # k8s backend); non-empty means the pod has been scheduled onto a node
+    node_name: str = ""
     extra: Dict[str, Any] = field(default_factory=dict)  # volumes, affinity, ... passthrough
 
     def container(self, *names: str) -> Optional[Container]:

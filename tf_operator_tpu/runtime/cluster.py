@@ -13,8 +13,10 @@ controller only ever sees `ClusterInterface`, and backends provide it:
                         process runtime.
   - LocalProcessCluster (runtime/local.py) — pods become real subprocesses;
                         hermetic E2E and real single-host TPU runs.
-  - a real Kubernetes backend can implement the same interface with client-go
-    semantics (out of scope for a TPU-sandbox build, API shape kept compatible).
+  - KubernetesCluster  (runtime/k8s.py) — the real apiserver over the wire:
+                        typed converters, watch streams with resourceVersion
+                        resume/410 relist, leader-election Leases, and
+                        pods/binding-based gang admission.
 
 Watch events fire synchronously after the store mutation commits, mirroring
 informer delivery order for a single writer.
@@ -27,6 +29,7 @@ import time
 from enum import Enum
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..api import constants
 from ..api.core import Event, ObjectMeta, Pod, PodDisruptionBudget, PodGroup, Service
 from ..api.types import JobStatus, TPUJob
 
@@ -208,7 +211,7 @@ class InMemoryCluster(ClusterInterface):
             # atomically via bind_pod (runtime/scheduler.py).
             self._dispatch(self._pod_handlers, EventType.ADDED, pod)
             return pod
-        pod.metadata.annotations["tpu-operator.dev/bound"] = "true"
+        pod.metadata.annotations[constants.ANNOTATION_BOUND] = "true"
         self._started_pod(pod)
         self._dispatch(self._pod_handlers, EventType.ADDED, pod)
         return pod
@@ -223,8 +226,6 @@ class InMemoryCluster(ClusterInterface):
         # scheduler name.  A template-set scheduler_name with nobody admitting
         # it (e.g. pdb-mode gangs, custom names) must start normally, not hang
         # Pending forever.
-        from ..api import constants
-
         return bool(
             pod.spec.scheduler_name
             and pod.spec.scheduler_name in self._gang_scheduler_names
@@ -235,9 +236,9 @@ class InMemoryCluster(ClusterInterface):
         """Admit a gang-held pod: mark bound and start it."""
         with self._lock:
             pod = self.get_pod(namespace, name)
-            if pod.metadata.annotations.get("tpu-operator.dev/bound") == "true":
+            if pod.metadata.annotations.get(constants.ANNOTATION_BOUND) == "true":
                 return
-            pod.metadata.annotations["tpu-operator.dev/bound"] = "true"
+            pod.metadata.annotations[constants.ANNOTATION_BOUND] = "true"
         self._started_pod(pod)
         self._dispatch(self._pod_handlers, EventType.MODIFIED, pod)
 

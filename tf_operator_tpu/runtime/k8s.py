@@ -28,7 +28,7 @@ import ssl
 import threading
 import time
 from http.client import HTTPConnection, HTTPException, HTTPSConnection
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import urlencode, urlsplit
 
 from ..api import constants, serialization
@@ -201,6 +201,8 @@ def pod_to_k8s(pod: Pod) -> Dict[str, Any]:
         spec["schedulerName"] = pod.spec.scheduler_name
     if pod.spec.node_selector:
         spec["nodeSelector"] = dict(pod.spec.node_selector)
+    if pod.spec.node_name:
+        spec["nodeName"] = pod.spec.node_name
     spec.update(pod.spec.extra)  # volumes, affinity, ... passthrough
     return {
         "apiVersion": "v1",
@@ -212,12 +214,14 @@ def pod_to_k8s(pod: Pod) -> Dict[str, Any]:
 
 def pod_from_k8s(raw: Dict[str, Any]) -> Pod:
     spec_raw = raw.get("spec") or {}
-    known = {"containers", "restartPolicy", "schedulerName", "nodeSelector"}
+    known = {"containers", "restartPolicy", "schedulerName", "nodeSelector",
+             "nodeName"}
     template = PodTemplateSpec(
         containers=[container_from_k8s(c) for c in spec_raw.get("containers") or []],
         restart_policy=spec_raw.get("restartPolicy", ""),
         scheduler_name=spec_raw.get("schedulerName", ""),
         node_selector=dict(spec_raw.get("nodeSelector") or {}),
+        node_name=spec_raw.get("nodeName", ""),
         extra={k: v for k, v in spec_raw.items() if k not in known},
     )
     status_raw = raw.get("status") or {}
@@ -773,6 +777,124 @@ class KubernetesCluster(ClusterInterface):
             },
         )
 
+    # -- scheduling (pods/binding subresource) --
+    #
+    # The in-process GangScheduler (runtime/scheduler.py) defers pod startup
+    # until the whole gang is admitted, then binds each member.  On the k8s
+    # backend "binding" is the real thing: pods stamped with our scheduler
+    # name are ignored by kube-scheduler (schedulerName mismatch), sit
+    # unscheduled, and start only when we POST the pods/binding subresource —
+    # the same protocol every custom scheduler uses.  The reference never
+    # binds (it delegates gang admission to Volcano, job_controller.go:211-239);
+    # here the operator itself can be the gang scheduler on a plain cluster.
+
+    def list_nodes(self) -> List[Dict[str, Any]]:
+        """Raw node objects — metadata.labels for selector matching and
+        status.allocatable for resource fit."""
+        raw = self.client.request("GET", "/api/v1/nodes")
+        return list(raw.get("items", []))
+
+    @staticmethod
+    def _pod_tpu_request(spec: Dict[str, Any]) -> float:
+        total = 0.0
+        for c in spec.get("containers") or []:
+            limits = ((c.get("resources") or {}).get("limits")
+                      or (c.get("resources") or {}).get("requests") or {})
+            total += quantity_to_float(limits.get(constants.TPU_RESOURCE, 0))
+        return total
+
+    def bind_pod(self, namespace: str, name: str) -> None:
+        """Schedule one admitted gang pod (see bind_pods)."""
+        self.bind_pods([(namespace, name)])
+
+    def bind_pods(self, targets: List[Tuple[str, str]]) -> None:
+        """Schedule admitted gang pods: pick a feasible node per pod and POST
+        the pods/binding subresource.  Feasibility = the pod's nodeSelector
+        is a subset of the node's labels, and the node's allocatable TPU
+        chips cover the request on top of non-terminal pods already bound
+        there.  The node and usage snapshots are taken ONCE per call — one
+        nodes LIST + one pods LIST for the whole gang, not per member.  A
+        pod with no feasible node stays Pending with a FailedScheduling
+        event; the gang scheduler's periodic retry picks it up once nodes
+        change (node churn produces no pod watch events)."""
+        if not targets:
+            return
+        nodes = self.list_nodes()
+        used: Dict[str, float] = {}
+        wanted = set(targets)
+        raw_pods: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        for other in self.client.request("GET", "/api/v1/pods").get("items", []):
+            meta = other.get("metadata") or {}
+            key = (meta.get("namespace", "default"), meta.get("name", ""))
+            if key in wanted:
+                raw_pods[key] = other
+            ospec = other.get("spec") or {}
+            node = ospec.get("nodeName")
+            # Terminal pods keep spec.nodeName forever but hold no chips —
+            # counting them would permanently starve the node.
+            if not node or (other.get("status") or {}).get("phase") in (
+                    "Succeeded", "Failed"):
+                continue
+            used[node] = used.get(node, 0.0) + self._pod_tpu_request(ospec)
+
+        # Phase 1 — place every member against the snapshot WITHOUT posting
+        # anything.  If any live, unbound member has no feasible node, bind
+        # nothing: starting the feasible subset would be a partial gang,
+        # the exact state gang scheduling exists to prevent.  The gang keeps
+        # its admission; the periodic retry re-attempts once nodes change.
+        plan: List[Tuple[str, str, str]] = []
+        infeasible: List[Tuple[str, str, dict, float]] = []
+        for namespace, name in targets:
+            raw = raw_pods.get((namespace, name))
+            if raw is None:
+                continue  # deleted between admission snapshot and bind
+            spec = raw.get("spec") or {}
+            if spec.get("nodeName"):
+                continue  # already bound
+            selector = spec.get("nodeSelector") or {}
+            requested = self._pod_tpu_request(spec)
+            target = None
+            for node in nodes:
+                labels = (node.get("metadata") or {}).get("labels") or {}
+                if any(labels.get(k) != v for k, v in selector.items()):
+                    continue
+                node_name = (node.get("metadata") or {}).get("name", "")
+                if requested:
+                    allocatable = quantity_to_float(
+                        ((node.get("status") or {}).get("allocatable") or {})
+                        .get(constants.TPU_RESOURCE, 0))
+                    if used.get(node_name, 0.0) + requested > allocatable:
+                        continue
+                target = node_name
+                break
+            if target is None:
+                infeasible.append((namespace, name, selector, requested))
+            else:
+                plan.append((namespace, name, target))
+                used[target] = used.get(target, 0.0) + requested
+        if infeasible:
+            for namespace, name, selector, requested in infeasible:
+                self.record_event(Event(
+                    object_kind="Pod", object_name=name, namespace=namespace,
+                    event_type="Warning", reason="FailedScheduling",
+                    message=(f"no node satisfies nodeSelector {selector} with "
+                             f"{requested:g} {constants.TPU_RESOURCE} "
+                             "available; holding the whole gang unbound"),
+                ))
+            return
+
+        # Phase 2 — post the bindings.
+        for namespace, name, target in plan:
+            self.client.request(
+                "POST", f"{self._core_path(namespace, 'pods', name)}/binding",
+                body={
+                    "apiVersion": "v1",
+                    "kind": "Binding",
+                    "metadata": {"name": name, "namespace": namespace},
+                    "target": {"apiVersion": "v1", "kind": "Node", "name": target},
+                },
+            )
+
     # -- services --
 
     def create_service(self, svc: Service) -> Service:
@@ -813,6 +935,30 @@ class KubernetesCluster(ClusterInterface):
         return podgroup_from_k8s(
             self.client.request("GET", self._podgroup_path(namespace, name))
         )
+
+    def update_podgroup(self, pg: PodGroup) -> PodGroup:
+        """Persist PodGroup mutations (the gang scheduler's phase writes —
+        on InMemoryCluster the returned object is shared so mutation sticks;
+        over the wire it must be written back).  CR updates require
+        metadata.resourceVersion, so read-inject-PUT with one retry on a
+        write conflict, same as update_job.  Only meaningful against the
+        operator's own PodGroup CRD (manifests/podgroup.yaml, no status
+        subresource); under --gang-mechanism volcano the in-process
+        scheduler — the only phase writer — doesn't run at all."""
+        path = self._podgroup_path(pg.metadata.namespace, pg.metadata.name)
+        body = podgroup_to_k8s(pg)
+        for attempt in (0, 1):
+            current = self.client.request("GET", path)
+            body["metadata"]["resourceVersion"] = (
+                current.get("metadata") or {}
+            ).get("resourceVersion", "")
+            try:
+                raw = self.client.request("PUT", path, body=body)
+                return podgroup_from_k8s(raw)
+            except AlreadyExists:  # 409 conflict: refetch and retry once
+                if attempt:
+                    raise
+        raise AssertionError("unreachable")
 
     def delete_podgroup(self, namespace: str, name: str) -> None:
         self.client.request("DELETE", self._podgroup_path(namespace, name))

@@ -100,10 +100,14 @@ class ReconcilerConfig:
     reconciler_sync_loop_period: float = 15.0
     enable_gang_scheduling: bool = False
     gang_scheduler_name: str = constants.GANG_SCHEDULER_NAME
-    # "podgroup": all-or-nothing admission via PodGroup + gang scheduler
-    # (ref: SyncPodGroup, job_controller.go:211-239).  "pdb": default
-    # scheduler + PodDisruptionBudget guarding voluntary evictions
-    # (ref: SyncPdb, job_controller.go:242-316).
+    # "podgroup": all-or-nothing admission via PodGroup + the in-process
+    # gang scheduler (runtime/scheduler.py; PodGroup shape ref: SyncPodGroup,
+    # job_controller.go:211-239).  "volcano": same PodGroup, but pods carry
+    # the reference's exact gang shapes — schedulerName "volcano" + the
+    # scheduling.k8s.io/group-name annotation (pod.go:43,52-53,472-488) — so
+    # a cluster-installed Volcano enforces admission and no in-process
+    # scheduler runs.  "pdb": default scheduler + PodDisruptionBudget
+    # guarding voluntary evictions (ref: SyncPdb, job_controller.go:242-316).
     gang_mechanism: str = "podgroup"
 
 
@@ -508,12 +512,35 @@ class JobReconciler:
         _set_restart_policy(pod, rspec)
 
         if self.config.enable_gang_scheduling:
-            # (ref: pod.go:218-231 — scheduler name + group annotation).
+            # (ref: pod.go:472-488 — scheduler name + group annotation; a
+            # user-specified scheduler is warned about, never overridden).
             # The pdb mechanism keeps the default scheduler: protection comes
             # from the budget, not from admission.
-            if self.config.gang_mechanism != "pdb" and not pod.spec.scheduler_name:
-                pod.spec.scheduler_name = self.config.gang_scheduler_name
-            pod.metadata.annotations[constants.GANG_GROUP_ANNOTATION] = job.metadata.name
+            gang_name = (
+                constants.VOLCANO_SCHEDULER_NAME
+                if self.config.gang_mechanism == "volcano"
+                else self.config.gang_scheduler_name
+            )
+            if self.config.gang_mechanism != "pdb":
+                if pod.spec.scheduler_name and pod.spec.scheduler_name != gang_name:
+                    self.cluster.record_event(Event(
+                        object_kind=job.kind,
+                        object_name=job.metadata.name,
+                        namespace=job.metadata.namespace,
+                        event_type="Warning",
+                        reason="PodTemplateSchedulerName",
+                        message=("Another scheduler is specified when "
+                                 "gang-scheduling is enabled and it will "
+                                 "not be overwritten"),
+                    ))
+                elif not pod.spec.scheduler_name:
+                    pod.spec.scheduler_name = gang_name
+            group_annotation = (
+                constants.VOLCANO_GROUP_ANNOTATION
+                if self.config.gang_mechanism == "volcano"
+                else constants.GANG_GROUP_ANNOTATION
+            )
+            pod.metadata.annotations[group_annotation] = job.metadata.name
         if rspec.tpu is not None and rspec.tpu.topology:
             # Slice shape for the scheduler's slice-shaped admission
             # (runtime/slices.py); slice id/host written back at admission.

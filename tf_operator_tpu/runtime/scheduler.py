@@ -100,11 +100,18 @@ class GangScheduler:
     def __init__(self, cluster: ClusterInterface,
                  total_chips: Optional[float] = None,
                  scheduler_name: str = constants.GANG_SCHEDULER_NAME,
-                 slice_provider: Optional[SliceProvider] = None) -> None:
+                 slice_provider: Optional[SliceProvider] = None,
+                 retry_interval: float = 30.0) -> None:
         self.cluster = cluster
         self.pool = SlicePool(total_chips)
         self.scheduler_name = scheduler_name
         self.slice_provider = slice_provider
+        self._stopped = threading.Event()
+        # Serializes bind batches across threads (watch dispatch vs the
+        # periodic retry sweep).  Binds run outside self._lock by design,
+        # but two concurrent bind_pods calls would each snapshot node usage
+        # before either posts, overcommitting a node's chips.
+        self._bind_lock = threading.Lock()
         self._lock = threading.Lock()
         # group key -> reserved chips (admitted gangs)
         self._admitted: Dict[str, float] = {}
@@ -123,6 +130,27 @@ class GangScheduler:
         cluster.watch_pods(self._on_pod_event)
         if slice_provider is not None:
             slice_provider.watch(self._on_slice_event)
+        # Node-side changes (labels added, capacity freed by non-gang pods,
+        # new nodes) produce no POD watch events, so event-driven retries
+        # alone can strand a waiting gang forever on a quiet cluster.  A
+        # periodic sweep re-attempts admission/binding for unbound gang pods;
+        # it is idempotent (admission is lock-guarded, binds skip bound pods).
+        if retry_interval:
+            threading.Thread(
+                target=self._retry_loop, args=(retry_interval,),
+                daemon=True, name="gang-retry",
+            ).start()
+
+    def _retry_loop(self, interval: float) -> None:
+        while not self._stopped.wait(interval):
+            try:
+                self._retry_waiting()
+            except Exception as exc:  # noqa: BLE001 — keep the sweep alive
+                log.warning("periodic gang retry failed: %r", exc)
+
+    def close(self) -> None:
+        """Stop the periodic retry sweep (tests / controller shutdown)."""
+        self._stopped.set()
 
     @staticmethod
     def _group_key(pod: Pod) -> Optional[str]:
@@ -198,8 +226,10 @@ class GangScheduler:
         # Atomic check-admit section: the already-admitted check, the chip
         # reservation, and the admitted record must not interleave with a
         # concurrent _try_admit for the same gang (double-reserve would leak
-        # pool capacity permanently).
+        # pool capacity permanently).  Phase writes are deferred out of the
+        # lock — on the k8s backend they are network round-trips.
         assignment: List[tuple] = []
+        waiting = False
         with self._lock:
             if key in self._admitted:
                 assignment = None  # lost the race; another thread admitted
@@ -213,27 +243,29 @@ class GangScheduler:
                         "gang %s waiting: %.0f chips requested, %.0f/%s in use",
                         key, chips, self.pool.used, self.pool.total,
                     )
-                    podgroup.phase = "Pending"
-                    return
-                granted = self._allocate_slices(key, sliced)
-                if granted is None:
-                    # Slice shapes unavailable: whole gang stays Pending —
-                    # a partial slice set is as useless as a partial gang.
-                    self.pool.release(chips)
-                    podgroup.phase = "Pending"
-                    self._warn_unsatisfiable(key, namespace, group_name, sliced)
-                    return
-                assignment = granted
-                self._admitted[key] = chips
+                    waiting = True
+                else:
+                    granted = self._allocate_slices(key, sliced)
+                    if granted is None:
+                        # Slice shapes unavailable: whole gang stays Pending —
+                        # a partial slice set is as useless as a partial gang.
+                        self.pool.release(chips)
+                        self._warn_unsatisfiable(key, namespace, group_name, sliced)
+                        waiting = True
+                    else:
+                        assignment = granted
+                        self._admitted[key] = chips
+        if waiting:
+            self._set_podgroup_phase(podgroup, "Pending")
+            return
         if assignment is None:
             self._assign_late(key, unbound)
             return
         # Annotation writes dispatch watch events, so they happen unlocked.
         self._apply_slice_assignment(assignment)
-        podgroup.phase = "Running"
+        self._set_podgroup_phase(podgroup, "Running")
         log.info("admitting gang %s (%d pods, %.0f chips)", key, len(pods), chips)
-        for pod in unbound:
-            self._bind(pod)
+        self._bind_all(unbound)
 
     # ------------------------------------------------------------------
     # slice-shaped allocation (runtime/slices.py; no reference analogue)
@@ -378,10 +410,7 @@ class GangScheduler:
                         pod.metadata.namespace, sid, rank
                     )
         self._apply_slice_assignment(assignment)
-        for pod in bind_plain:
-            self._bind(pod)
-        for pod, _sid, _rank in assignment:
-            self._bind(pod)
+        self._bind_all(bind_plain + [pod for pod, _sid, _rank in assignment])
 
     def _warn_unsatisfiable(self, key: str, namespace: str, group_name: str,
                             sliced: List[Pod]) -> None:
@@ -484,15 +513,70 @@ class GangScheduler:
 
     @staticmethod
     def _is_bound(pod: Pod) -> bool:
-        return pod.metadata.annotations.get("tpu-operator.dev/bound") == "true"
+        # InMemory/local substrates stamp the bound annotation; the k8s
+        # backend binds via the pods/binding subresource, which materializes
+        # as spec.nodeName.
+        return bool(
+            pod.spec.node_name
+            or pod.metadata.annotations.get(constants.ANNOTATION_BOUND) == "true"
+        )
+
+    def _set_podgroup_phase(self, podgroup, phase: str) -> None:
+        """Mutate + persist the PodGroup phase.  InMemoryCluster hands out
+        the stored object so mutation alone sticks; remote backends expose
+        update_podgroup for the write-back.  Never called under self._lock
+        (the write is a network round-trip on the k8s backend), and never
+        allowed to raise — a failed phase write must not abort the binds
+        that follow it (the phase is observability, not admission state)."""
+        if podgroup.phase == phase:
+            return
+        podgroup.phase = phase
+        writer = getattr(self.cluster, "update_podgroup", None)
+        if writer is None:
+            return
+        try:
+            writer(podgroup)
+        except NotFound:
+            pass  # group deleted mid-admission; departure path reconciles
+        except Exception as exc:  # noqa: BLE001 — see docstring
+            log.warning("podgroup %s phase write failed: %r",
+                        podgroup.metadata.name, exc)
+
+    def _bind_all(self, pods: List[Pod]) -> None:
+        """Bind every pod, isolating failures: one member's transient bind
+        error (5xx, racing 409) must not abort the siblings — a partially
+        started gang is the exact state gang scheduling exists to prevent.
+        Failed members stay Pending and the periodic retry re-attempts them
+        (the gang is already admitted, so _assign_late just re-binds).
+        Batches through cluster.bind_pods when the backend has it (one
+        node/usage snapshot per gang instead of per member)."""
+        if not pods:
+            return
+        with self._bind_lock:
+            batch = getattr(self.cluster, "bind_pods", None)
+            if batch is not None:
+                try:
+                    batch([(p.metadata.namespace, p.metadata.name)
+                           for p in pods])
+                    return
+                except Exception as exc:  # noqa: BLE001 — fall back to singles
+                    log.warning("batch bind failed (%r); retrying individually",
+                                exc)
+            for pod in pods:
+                self._bind(pod)
 
     def _bind(self, pod: Pod) -> None:
         binder = getattr(self.cluster, "bind_pod", None)
-        if binder is not None:
-            try:
-                binder(pod.metadata.namespace, pod.metadata.name)
-            except NotFound:
-                pass  # deleted between admission snapshot and bind
+        if binder is None:
+            return
+        try:
+            binder(pod.metadata.namespace, pod.metadata.name)
+        except NotFound:
+            pass  # deleted between admission snapshot and bind
+        except Exception as exc:  # noqa: BLE001 — isolate member failures
+            log.warning("bind of %s/%s failed: %r; it stays Pending until "
+                        "the next retry", pod.metadata.namespace,
+                        pod.metadata.name, exc)
 
     def _retry_waiting(self) -> None:
         """Retry admission for every gang with unbound pods — waiting gangs

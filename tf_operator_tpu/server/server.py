@@ -46,9 +46,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         action="store_false")
     parser.add_argument("--enable-gang-scheduling", action="store_true")
     parser.add_argument("--gang-scheduler-name", default="tpu-gang")
-    parser.add_argument("--gang-mechanism", choices=("podgroup", "pdb"),
+    parser.add_argument("--gang-mechanism",
+                        choices=("podgroup", "volcano", "pdb"),
                         default="podgroup",
-                        help="podgroup: all-or-nothing slice admission; "
+                        help="podgroup: all-or-nothing slice admission by "
+                        "the operator's in-process gang scheduler; volcano: "
+                        "delegate admission to a cluster-installed Volcano "
+                        "(schedulerName volcano + scheduling.k8s.io/"
+                        "group-name, the reference's exact shapes); "
                         "pdb: default scheduler + disruption budget "
                         "(ref: SyncPodGroup vs SyncPdb)")
     parser.add_argument("--slice-chips", type=float, default=None,
@@ -193,13 +198,21 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
         threadiness=args.threadiness,
         **({"resolver": resolver_owner.resolver} if resolver_owner else {}),
     )
-    if getattr(args, "slice_inventory", None) and not (
+    gang_in_process = (
         args.enable_gang_scheduling and args.gang_mechanism == "podgroup"
-    ):
+    )
+    if getattr(args, "slice_inventory", None) and not gang_in_process:
         raise SystemExit(
             "--slice-inventory requires --enable-gang-scheduling with "
             "--gang-mechanism podgroup (slice-shaped admission is enforced "
             "by the gang scheduler); the inventory would otherwise be ignored"
+        )
+    if args.slice_chips is not None and not gang_in_process:
+        raise SystemExit(
+            "--slice-chips requires --enable-gang-scheduling with "
+            "--gang-mechanism podgroup (the chip-capacity cap is enforced "
+            "by the in-process gang scheduler); with --gang-mechanism "
+            "volcano or pdb the cap would be silently unenforced"
         )
     if args.enable_gang_scheduling and args.gang_mechanism == "podgroup":
         from ..runtime.scheduler import GangScheduler
