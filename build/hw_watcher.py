@@ -42,7 +42,9 @@ def log(msg: str) -> None:
 
 
 def run(cmd, timeout, env=None):
-    """Run cmd, return (rc, combined output); rc=None on timeout."""
+    """Run cmd, return (rc, stdout, stderr); rc=None on timeout.  stdout is
+    kept separate — bench.py's one JSON line goes to stdout and must not be
+    buried under trailing stderr warnings."""
     full_env = dict(os.environ)
     if env:
         full_env.update(env)
@@ -50,16 +52,18 @@ def run(cmd, timeout, env=None):
         r = subprocess.run(
             cmd, capture_output=True, text=True, timeout=timeout,
             env=full_env, cwd=ROOT)
-        return r.returncode, (r.stdout or "") + (r.stderr or "")
+        return r.returncode, r.stdout or "", r.stderr or ""
     except subprocess.TimeoutExpired as e:
-        out = (e.stdout or b"")
+        out, err = e.stdout or b"", e.stderr or b""
         if isinstance(out, bytes):
             out = out.decode("utf-8", "replace")
-        return None, out
+        if isinstance(err, bytes):
+            err = err.decode("utf-8", "replace")
+        return None, out, err
 
 
 def probe() -> bool:
-    rc, out = run(
+    rc, out, _err = run(
         [sys.executable, "-c",
          "import jax; print(jax.devices()[0].platform)"],
         timeout=90)
@@ -87,8 +91,8 @@ def bench_complete(path: str) -> bool:
 
 def do_bench() -> bool:
     log("stage bench: starting (BENCH_MODEL=lm first)")
-    rc, out = run([sys.executable, "bench.py"], timeout=3900,
-                  env={"BENCH_MODEL": "lm"})
+    rc, out, _err = run([sys.executable, "bench.py"], timeout=3900,
+                        env={"BENCH_MODEL": "lm"})
     lines = [ln for ln in out.strip().splitlines() if ln.strip()]
     if not lines:
         log(f"stage bench: no output (rc={rc})")
@@ -122,8 +126,9 @@ def do_pytest(expr, timeout, dest, label) -> bool:
     cmd = [sys.executable, "-m", "pytest", "tests/", "-m", "tpu", "-v"]
     if expr:
         cmd += ["-k", expr]
-    rc, out = run(cmd, timeout=timeout, env={"TPUJOB_TEST_PLATFORM": "tpu"})
-    tail = "\n".join(out.strip().splitlines()[-40:])
+    rc, out, err = run(cmd, timeout=timeout,
+                       env={"TPUJOB_TEST_PLATFORM": "tpu"})
+    tail = "\n".join((out + "\n" + err).strip().splitlines()[-40:])
     if rc == 0 and "passed" in tail and tail.strip():
         tmp = dest + ".tmp"
         with open(tmp, "w") as f:

@@ -53,6 +53,22 @@ def k8s():
     server.stop()
 
 
+@pytest.fixture()
+def gang_sched(k8s):
+    """GangScheduler factory with fixture-owned close() — a leaked default
+    30s retry thread would outlive the fake apiserver and spam warnings."""
+    created = []
+
+    def factory(**kwargs):
+        sched = GangScheduler(k8s[1], **kwargs)
+        created.append(sched)
+        return sched
+
+    yield factory
+    for sched in created:
+        sched.close()
+
+
 def _wait(predicate, timeout=15.0, interval=0.05):
     deadline = time.time() + timeout
     while time.time() < deadline:
@@ -124,6 +140,34 @@ def test_volcano_mechanism_keeps_user_scheduler(k8s):
     assert any(e.reason == "PodTemplateSchedulerName" for e in events)
 
 
+def test_in_process_mechanism_uses_operator_podgroup_crd():
+    """--gang-mechanism podgroup over k8s must address the operator's OWN
+    PodGroup CRD (manifests/podgroup.yaml) — Volcano's API group need not
+    exist on a plain cluster."""
+    from tf_operator_tpu.runtime.k8s import TPU_PODGROUP_API
+
+    server = FakeApiServer()
+    url = server.start()
+    cluster = KubernetesCluster(
+        KubeConfig(host=url, namespace="default"), namespace="default",
+        podgroup_api=TPU_PODGROUP_API,
+    )
+    try:
+        cluster.create_podgroup(PodGroup(
+            metadata=ObjectMeta(name="own-crd", namespace="default"),
+            min_member=2,
+        ))
+        path = ("/apis/scheduling.tpu-operator.dev/v1/namespaces/default"
+                "/podgroups")
+        assert ("POST", path) in server.requests
+        pg = server.objects("podgroups")["own-crd"]
+        assert pg["apiVersion"] == "scheduling.tpu-operator.dev/v1"
+        assert cluster.get_podgroup("default", "own-crd").min_member == 2
+    finally:
+        cluster.close()
+        server.stop()
+
+
 # ---------------------------------------------------------------------------
 # podgroup mechanism over k8s: the operator binds through pods/binding
 
@@ -155,10 +199,10 @@ def _node_of(server, pod_name):
     return (pod.get("spec") or {}).get("nodeName")
 
 
-def test_gang_binds_atomically_via_binding_subresource(k8s):
+def test_gang_binds_atomically_via_binding_subresource(k8s, gang_sched):
     server, cluster = k8s
     server.add_node("tpu-node-0", allocatable={constants.TPU_RESOURCE: "8"})
-    GangScheduler(cluster)
+    gang_sched()
 
     cluster.create_podgroup(PodGroup(
         metadata=ObjectMeta(name="g1", namespace="default"), min_member=2,
@@ -188,7 +232,7 @@ def test_gang_binds_atomically_via_binding_subresource(k8s):
                  .get("status", {}).get("phase") == "Running")
 
 
-def test_binding_respects_capacity_and_selector(k8s):
+def test_binding_respects_capacity_and_selector(k8s, gang_sched):
     server, cluster = k8s
     # node-a: TPU node with room for one 4-chip pod; node-b: bigger TPU node
     # behind a selector; node-c: CPU-only, must never receive gang pods
@@ -203,7 +247,7 @@ def test_binding_respects_capacity_and_selector(k8s):
         allocatable={constants.TPU_RESOURCE: "8"},
     )
     server.add_node("node-c", labels={"cpu": "only"})
-    GangScheduler(cluster)
+    gang_sched()
 
     cluster.create_podgroup(PodGroup(
         metadata=ObjectMeta(name="g2", namespace="default"), min_member=2,
@@ -221,10 +265,10 @@ def test_binding_respects_capacity_and_selector(k8s):
     assert _node_of(server, "g2-worker-0") == "node-a"
 
 
-def test_unschedulable_pod_gets_warning_event(k8s):
+def test_unschedulable_pod_gets_warning_event(k8s, gang_sched):
     server, cluster = k8s
     server.add_node("small-node", allocatable={constants.TPU_RESOURCE: "2"})
-    GangScheduler(cluster)
+    gang_sched()
 
     cluster.create_podgroup(PodGroup(
         metadata=ObjectMeta(name="g3", namespace="default"), min_member=1,
@@ -238,12 +282,12 @@ def test_unschedulable_pod_gets_warning_event(k8s):
     assert not _node_of(server, "g3-worker-0")
 
 
-def test_no_partial_gang_when_one_member_infeasible(k8s):
+def test_no_partial_gang_when_one_member_infeasible(k8s, gang_sched):
     """If any member has no feasible node, NO member binds — the feasible
     subset starting alone would be a partial gang."""
     server, cluster = k8s
     server.add_node("four-chip", allocatable={constants.TPU_RESOURCE: "4"})
-    sched = GangScheduler(cluster, retry_interval=0.3)
+    sched = gang_sched(retry_interval=0.3)
     try:
         cluster.create_podgroup(PodGroup(
             metadata=ObjectMeta(name="g7", namespace="default"), min_member=2,
@@ -256,6 +300,11 @@ def test_no_partial_gang_when_one_member_infeasible(k8s):
         assert not _node_of(server, "g7-worker-0")
         assert not _node_of(server, "g7-worker-1")
         assert not any(p.endswith("/binding") for _m, p in server.requests)
+        # the 0.3s retry sweep keeps attempting, but events are deduped —
+        # one FailedScheduling per pod per dry spell, not one per sweep
+        time.sleep(1.0)
+        assert len([e for e in cluster.list_events(object_name="g7-worker-1")
+                    if e.reason == "FailedScheduling"]) == 1
 
         # a second node makes the whole gang feasible; the sweep binds both
         server.add_node("four-chip-b",
@@ -266,11 +315,11 @@ def test_no_partial_gang_when_one_member_infeasible(k8s):
         sched.close()
 
 
-def test_retry_binds_after_node_appears(k8s):
+def test_retry_binds_after_node_appears(k8s, gang_sched):
     """Node churn produces no pod watch events; the periodic sweep must pick
     up a stranded-but-admitted gang once a feasible node exists."""
     server, cluster = k8s
-    sched = GangScheduler(cluster, retry_interval=0.3)
+    sched = gang_sched(retry_interval=0.3)
     try:
         cluster.create_podgroup(PodGroup(
             metadata=ObjectMeta(name="g4", namespace="default"), min_member=1,
@@ -288,12 +337,12 @@ def test_retry_binds_after_node_appears(k8s):
         sched.close()
 
 
-def test_terminal_pods_release_node_capacity(k8s):
+def test_terminal_pods_release_node_capacity(k8s, gang_sched):
     """Completed pods keep spec.nodeName forever; counting their chips would
     permanently starve the node for every later gang."""
     server, cluster = k8s
     server.add_node("n0", allocatable={constants.TPU_RESOURCE: "4"})
-    sched = GangScheduler(cluster, retry_interval=0.3)
+    sched = gang_sched(retry_interval=0.3)
     try:
         cluster.create_podgroup(PodGroup(
             metadata=ObjectMeta(name="g5", namespace="default"), min_member=1,
@@ -316,7 +365,7 @@ def test_terminal_pods_release_node_capacity(k8s):
         sched.close()
 
 
-def test_controller_gang_pods_bind_end_to_end(k8s):
+def test_controller_gang_pods_bind_end_to_end(k8s, gang_sched):
     """Full loop: controller creates gang pods + PodGroup from a job; the
     GangScheduler over the SAME apiserver binds them via pods/binding."""
     server, cluster = k8s
@@ -325,7 +374,7 @@ def test_controller_gang_pods_bind_end_to_end(k8s):
         cluster,
         config=ReconcilerConfig(enable_gang_scheduling=True),
     )
-    GangScheduler(cluster)
+    gang_sched()
     job = new_tpujob(worker=2, name="gjob")
     cluster.create_job(job)
     controller.sync_job("default/gjob")

@@ -61,7 +61,14 @@ from .cluster import (
 
 log = tpulog.logger_for_key("k8s")
 
+# Volcano's PodGroup group/version — used by --gang-mechanism volcano so a
+# cluster-installed Volcano admits our gangs (reference parity,
+# vendor/.../common/job_controller.go:211-239).
 PODGROUP_API = "scheduling.volcano.sh/v1beta1"
+# The operator's own PodGroup CRD (manifests/podgroup.yaml) — used by
+# --gang-mechanism podgroup over --runtime k8s, where the in-process
+# GangScheduler is the consumer and Volcano need not be installed.
+TPU_PODGROUP_API = "scheduling.tpu-operator.dev/v1"
 SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
@@ -284,9 +291,9 @@ def job_to_k8s(job: TPUJob) -> Dict[str, Any]:
     return data
 
 
-def podgroup_to_k8s(pg: PodGroup) -> Dict[str, Any]:
+def podgroup_to_k8s(pg: PodGroup, api: str = PODGROUP_API) -> Dict[str, Any]:
     return {
-        "apiVersion": PODGROUP_API,
+        "apiVersion": api,
         "kind": "PodGroup",
         "metadata": meta_to_k8s(pg.metadata),
         "spec": {"minMember": pg.min_member, "queue": pg.queue or "default"},
@@ -582,7 +589,8 @@ class KubernetesCluster(ClusterInterface):
     """Drives a real apiserver; the controller above it is unchanged."""
 
     def __init__(self, config: Optional[KubeConfig] = None,
-                 namespace: Optional[str] = None) -> None:
+                 namespace: Optional[str] = None,
+                 podgroup_api: str = PODGROUP_API) -> None:
         self.config = config or default_config()
         self.client = KubeClient(self.config)
         # None = all namespaces (the reference's default, options.go:57-60)
@@ -595,6 +603,12 @@ class KubernetesCluster(ClusterInterface):
         self._watch_conns: List[Any] = []
         self._event_seq = 0
         self._identity = f"tpu-operator-{os.getpid()}"
+        # Which API group PodGroups live in: Volcano's (default, reference
+        # parity) or the operator's own CRD for the in-process gang path.
+        self.podgroup_api = podgroup_api
+        # (ns, name) pods already warned FailedScheduling this dry spell —
+        # the 30s retry sweep must not mint a new Event object per attempt.
+        self._sched_warned: set = set()
 
     # -- paths --
 
@@ -873,7 +887,13 @@ class KubernetesCluster(ClusterInterface):
                 plan.append((namespace, name, target))
                 used[target] = used.get(target, 0.0) + requested
         if infeasible:
+            # One FailedScheduling event per pod per dry spell — the 30s
+            # retry sweep re-runs this path indefinitely and must not mint
+            # a fresh Event object every attempt.
             for namespace, name, selector, requested in infeasible:
+                if (namespace, name) in self._sched_warned:
+                    continue
+                self._sched_warned.add((namespace, name))
                 self.record_event(Event(
                     object_kind="Pod", object_name=name, namespace=namespace,
                     event_type="Warning", reason="FailedScheduling",
@@ -894,6 +914,7 @@ class KubernetesCluster(ClusterInterface):
                     "target": {"apiVersion": "v1", "kind": "Node", "name": target},
                 },
             )
+            self._sched_warned.discard((namespace, name))
 
     # -- services --
 
@@ -921,13 +942,13 @@ class KubernetesCluster(ClusterInterface):
     # -- podgroups / pdbs --
 
     def _podgroup_path(self, namespace: str, name: str = "") -> str:
-        base = f"/apis/{PODGROUP_API}/namespaces/{namespace}/podgroups"
+        base = f"/apis/{self.podgroup_api}/namespaces/{namespace}/podgroups"
         return f"{base}/{name}" if name else base
 
     def create_podgroup(self, pg: PodGroup) -> PodGroup:
         raw = self.client.request(
             "POST", self._podgroup_path(pg.metadata.namespace),
-            body=podgroup_to_k8s(pg),
+            body=podgroup_to_k8s(pg, self.podgroup_api),
         )
         return podgroup_from_k8s(raw)
 
@@ -946,7 +967,7 @@ class KubernetesCluster(ClusterInterface):
         subresource); under --gang-mechanism volcano the in-process
         scheduler — the only phase writer — doesn't run at all."""
         path = self._podgroup_path(pg.metadata.namespace, pg.metadata.name)
-        body = podgroup_to_k8s(pg)
+        body = podgroup_to_k8s(pg, self.podgroup_api)
         for attempt in (0, 1):
             current = self.client.request("GET", path)
             body["metadata"]["resourceVersion"] = (

@@ -56,6 +56,13 @@ class LocalProcessCluster(InMemoryCluster):
             base_port = 20000 + (seed >> 8) % 12000
         self.base_port = base_port
         self.extra_env = dict(extra_env or {})
+        # image -> (command, args): the "pulled image entrypoint" analogue.
+        # A kubelet runs a command-less container through the image's
+        # entrypoint; this substrate has no images, so reference manifests
+        # (image-only containers, e.g. examples/v1/dist-mnist) run by
+        # registering what each image name executes locally.  Keyed by full
+        # image ref, falling back to the tagless name.
+        self._image_entrypoints: Dict[str, Tuple[list, list]] = {}
         self._procs: Dict[Tuple[str, str], subprocess.Popen] = {}
         self._ports: Dict[str, int] = {}
         self._port_lock = threading.Lock()
@@ -77,6 +84,22 @@ class LocalProcessCluster(InMemoryCluster):
             return self._ports[key]
 
     # ------------------------------------------------------------------
+    # image entrypoints (the "docker pull" analogue for this substrate)
+
+    def register_image(self, image: str, command: list,
+                       args: Optional[list] = None) -> None:
+        """Declare what `image` executes when a container specifies no
+        command — the local analogue of an image entrypoint, letting
+        reference TFJob manifests (command-less containers) run unmodified."""
+        self._image_entrypoints[image] = (list(command), list(args or []))
+
+    def resolve_image(self, image: str) -> Optional[Tuple[list, list]]:
+        entry = self._image_entrypoints.get(image)
+        if entry is None and ":" in image:
+            entry = self._image_entrypoints.get(image.rsplit(":", 1)[0])
+        return entry
+
+    # ------------------------------------------------------------------
     # pod lifecycle hooks
 
     def _started_pod(self, pod: Pod) -> None:
@@ -86,9 +109,16 @@ class LocalProcessCluster(InMemoryCluster):
         container = pod.spec.container(
             constants.DEFAULT_CONTAINER_NAME, constants.ALT_CONTAINER_NAME
         )
-        if container is None or not (container.command or container.args):
-            return  # nothing to run; stays Pending (image-only template)
-        argv = list(container.command) + list(container.args)
+        if container is None:
+            return
+        if container.command or container.args:
+            argv = list(container.command) + list(container.args)
+        else:
+            entry = self.resolve_image(container.image)
+            if entry is None:
+                return  # unknown image, no command; stays Pending
+            command, args = entry
+            argv = list(command) + list(args)
         env = dict(os.environ)
         env.update(self.extra_env)
         for e in container.env:

@@ -170,16 +170,31 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
     tpulog.configure(json_format=args.json_log_format, level=logging.INFO)
     log = tpulog.logger_for_key("server")
 
+    gang_in_process = (
+        args.enable_gang_scheduling and args.gang_mechanism == "podgroup"
+    )
     if cluster is None:
         if args.runtime == "k8s":
-            from ..runtime.k8s import KubeConfig, KubernetesCluster
+            from ..runtime.k8s import (
+                PODGROUP_API,
+                TPU_PODGROUP_API,
+                KubeConfig,
+                KubernetesCluster,
+            )
 
             kube = (
                 KubeConfig.from_kubeconfig(args.kubeconfig)
                 if args.kubeconfig
                 else None  # in-cluster / $KUBECONFIG resolution
             )
-            cluster = KubernetesCluster(kube, namespace=args.namespace or None)
+            cluster = KubernetesCluster(
+                kube, namespace=args.namespace or None,
+                # In-process gang admission uses the operator's own PodGroup
+                # CRD (manifests/podgroup.yaml); volcano/pdb modes keep the
+                # Volcano group so a cluster-installed Volcano sees them.
+                podgroup_api=(TPU_PODGROUP_API if gang_in_process
+                              else PODGROUP_API),
+            )
         elif args.runtime == "local":
             cluster = LocalProcessCluster(workdir=args.workdir)
         else:
@@ -198,9 +213,6 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
         threadiness=args.threadiness,
         **({"resolver": resolver_owner.resolver} if resolver_owner else {}),
     )
-    gang_in_process = (
-        args.enable_gang_scheduling and args.gang_mechanism == "podgroup"
-    )
     if getattr(args, "slice_inventory", None) and not gang_in_process:
         raise SystemExit(
             "--slice-inventory requires --enable-gang-scheduling with "
@@ -214,7 +226,7 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
             "by the in-process gang scheduler); with --gang-mechanism "
             "volcano or pdb the cap would be silently unenforced"
         )
-    if args.enable_gang_scheduling and args.gang_mechanism == "podgroup":
+    if gang_in_process:
         from ..runtime.scheduler import GangScheduler
 
         slice_provider = None
