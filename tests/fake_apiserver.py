@@ -108,6 +108,26 @@ class FakeApiServer:
                 except ValueError:
                     from_rv = 0
                 with server._lock:
+                    # A from_rv older than the retained event log means the
+                    # replay would silently skip dropped events; the real
+                    # apiserver signals 410 Gone / an Expired ERROR event
+                    # instead, forcing the client to relist.
+                    oldest = (server._event_log[0][0]
+                              if server._event_log else server._rv + 1)
+                    if from_rv and server._event_log and from_rv < oldest - 1:
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
+                        self.end_headers()
+                        self.wfile.write(json.dumps({
+                            "type": "ERROR",
+                            "object": {
+                                "kind": "Status", "code": 410,
+                                "reason": "Expired",
+                                "message": f"too old resource version: "
+                                           f"{from_rv}",
+                            },
+                        }).encode() + b"\n")
+                        return
                     # backlog replay + registration are atomic: no event can
                     # land between them
                     for erv, ekind, evt in server._event_log:

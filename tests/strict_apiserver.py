@@ -1,0 +1,489 @@
+"""A second, independently written apiserver stand-in for conformance tests.
+
+tests/fake_apiserver.py and runtime/k8s.py share an author, so a blind spot
+about real apiserver semantics could hide in both (VERDICT r03 "What's
+missing" #2).  This fixture is written from the Kubernetes API conventions
+(https://kubernetes.io/docs/reference/using-api/api-concepts/) rather than
+from what runtime/k8s.py happens to send, and enforces the contract points a
+home-grown fake typically soft-pedals:
+
+- per-object resourceVersion from one monotonically increasing revision
+  counter (etcd-style); LIST carries the collection revision;
+- UPDATE of a custom resource REQUIRES metadata.resourceVersion ("must be
+  specified for an update"); any provided stale resourceVersion is a 409
+  Conflict (built-ins accept an empty resourceVersion = last-write-wins);
+- kinds with a status subresource (tpujobs via manifests/crd.yaml, pods in
+  core v1): writes to the main resource never touch .status, and writes to
+  /status touch only .status;
+- merge-patch per RFC 7386 (null deletes a key), same subresource isolation;
+- watch: HTTP/1.1 chunked stream; a resourceVersion older than the retained
+  history window yields an ERROR event with a 410 "Expired" Status and the
+  stream closes — the client must relist (history_window is deliberately
+  small so tests exercise this);
+- eviction honors actual PodDisruptionBudget objects by selector math, not
+  a test toggle: evictions that would drop healthy pods below minAvailable
+  get 429;
+- pods/binding sets spec.nodeName exactly once (409 after);
+- DELETE returns the deleted object; errors are k8s Status objects.
+
+The conformance suite (tests/test_apiserver_conformance.py) runs the same
+scenarios against BOTH servers; behavioral divergence between them is a bug
+in one of the fixtures or in runtime/k8s.py's assumptions.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlsplit
+
+# API groups served by CRDs in this repo — strict update semantics.
+CR_GROUPS = {"tpu-operator.dev", "scheduling.tpu-operator.dev"}
+# plurals whose .status is a separate subresource
+STATUS_SUBRESOURCE = {"tpujobs", "pods"}
+
+_ROUTE = re.compile(
+    r"^/(?:api/v1|apis/(?P<group>[^/]+)/(?P<version>[^/]+))"
+    r"(?:/namespaces/(?P<ns>[^/]+))?/(?P<plural>[a-z]+)"
+    r"(?:/(?P<name>[^/]+))?(?:/(?P<sub>status|eviction|binding|log))?$"
+)
+
+
+def _merge7386(base, patch):
+    if not isinstance(patch, dict):
+        return patch
+    out = dict(base) if isinstance(base, dict) else {}
+    for key, value in patch.items():
+        if value is None:
+            out.pop(key, None)
+        elif isinstance(value, dict):
+            out[key] = _merge7386(out.get(key), value)
+        else:
+            out[key] = value
+    return out
+
+
+def _match_selector(obj: dict, selector: str) -> bool:
+    labels = (obj.get("metadata") or {}).get("labels") or {}
+    for term in selector.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "!=" in term:
+            k, v = term.split("!=", 1)
+            if labels.get(k) == v:
+                return False
+        elif "=" in term:
+            k, v = term.split("=", 1)
+            if labels.get(k.rstrip("=")) != v:
+                return False
+    return True
+
+
+class StrictApiServer:
+    """See module docstring.  Public surface mirrors FakeApiServer's test
+    hooks (start/stop/objects/set_pod_status/add_node/requests) so the
+    conformance suite can parametrize over both."""
+
+    def __init__(self, history_window: int = 64) -> None:
+        self._lock = threading.RLock()
+        self._rev = 0
+        self._uid = 0
+        # (plural, ns) -> name -> object
+        self._store: Dict[Tuple[str, str], Dict[str, dict]] = {}
+        # bounded event history: (rev, plural, event-dict)
+        self._history: List[Tuple[int, str, dict]] = []
+        self._history_window = history_window
+        self._watchers: List[Tuple[str, "queue.Queue"]] = []
+        self.requests: List[Tuple[str, str]] = []
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):
+                pass
+
+            # -- plumbing ------------------------------------------------
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(length)) if length else {}
+
+            def _reply(self, code: int, payload: dict) -> None:
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _status(self, code: int, reason: str, message: str) -> None:
+                self._reply(code, {
+                    "kind": "Status", "apiVersion": "v1", "status": "Failure",
+                    "code": code, "reason": reason, "message": message,
+                })
+
+            def _route(self):
+                parts = urlsplit(self.path)
+                m = _ROUTE.match(parts.path)
+                if m is None:
+                    return None
+                params = {k: v[0] for k, v in parse_qs(parts.query).items()}
+                return (m.group("group"), m.group("ns"), m.group("plural"),
+                        m.group("name"), m.group("sub"), params)
+
+            # -- verbs ---------------------------------------------------
+
+            def do_GET(self):
+                server.requests.append(("GET", self.path))
+                route = self._route()
+                if route is None:
+                    return self._status(404, "NotFound", f"no route {self.path}")
+                group, ns, plural, name, sub, params = route
+                if params.get("watch") == "true":
+                    return self._watch(plural, ns, params)
+                if sub == "log":
+                    with server._lock:
+                        obj = server._get(plural, ns, name)
+                    if obj is None:
+                        return self._status(404, "NotFound", "pod not found")
+                    text = (obj.get("_log") or "").encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(text)))
+                    self.end_headers()
+                    self.wfile.write(text)
+                    return None
+                with server._lock:
+                    if name:
+                        obj = server._get(plural, ns, name)
+                        if obj is None:
+                            return self._status(
+                                404, "NotFound",
+                                f'{plural} "{name}" not found')
+                        return self._reply(200, obj)
+                    items = server._list(plural, ns, params)
+                    return self._reply(200, {
+                        "kind": "List", "apiVersion": "v1", "items": items,
+                        "metadata": {"resourceVersion": str(server._rev)},
+                    })
+
+            def do_POST(self):
+                server.requests.append(("POST", self.path))
+                route = self._route()
+                if route is None:
+                    return self._status(404, "NotFound", f"no route {self.path}")
+                group, ns, plural, name, sub, _params = route
+                body = self._body()
+                if sub == "eviction":
+                    return self._evict(ns, name)
+                if sub == "binding":
+                    return self._bind(ns, name, body)
+                obj_name = (body.get("metadata") or {}).get("name", "")
+                if not obj_name:
+                    return self._status(400, "Invalid", "metadata.name required")
+                with server._lock:
+                    if server._get(plural, ns, obj_name) is not None:
+                        return self._status(
+                            409, "AlreadyExists",
+                            f'{plural} "{obj_name}" already exists')
+                    if plural in STATUS_SUBRESOURCE:
+                        body.pop("status", None)  # status is a subresource
+                    created = server._commit(plural, ns, obj_name, body,
+                                             new=True)
+                return self._reply(201, created)
+
+            def do_PUT(self):
+                server.requests.append(("PUT", self.path))
+                route = self._route()
+                if route is None or not route[3]:
+                    return self._status(404, "NotFound", f"no route {self.path}")
+                group, ns, plural, name, sub, _params = route
+                body = self._body()
+                with server._lock:
+                    current = server._get(plural, ns, name)
+                    if current is None:
+                        return self._status(
+                            404, "NotFound", f'{plural} "{name}" not found')
+                    sent_rv = (body.get("metadata") or {}).get(
+                        "resourceVersion", "")
+                    if group in CR_GROUPS and not sent_rv:
+                        return self._status(
+                            400, "Invalid",
+                            "metadata.resourceVersion: must be specified "
+                            "for an update")
+                    current_rv = current["metadata"]["resourceVersion"]
+                    if sent_rv and sent_rv != current_rv:
+                        return self._status(
+                            409, "Conflict",
+                            f'the object has been modified; the update is '
+                            f'based on resourceVersion {sent_rv}, current '
+                            f'is {current_rv}')
+                    if sub == "status":
+                        merged = dict(current)
+                        merged["status"] = body.get("status")
+                        body = merged
+                    elif plural in STATUS_SUBRESOURCE:
+                        body = dict(body)
+                        if "status" in current:
+                            body["status"] = current["status"]
+                        else:
+                            body.pop("status", None)
+                    updated = server._commit(plural, ns, name, body)
+                return self._reply(200, updated)
+
+            def do_PATCH(self):
+                server.requests.append(("PATCH", self.path))
+                route = self._route()
+                if route is None or not route[3]:
+                    return self._status(404, "NotFound", f"no route {self.path}")
+                _group, ns, plural, name, sub, _params = route
+                patch = self._body()
+                with server._lock:
+                    current = server._get(plural, ns, name)
+                    if current is None:
+                        return self._status(
+                            404, "NotFound", f'{plural} "{name}" not found')
+                    if sub == "status":
+                        merged = dict(current)
+                        merged["status"] = _merge7386(
+                            current.get("status"), patch.get("status"))
+                    else:
+                        if plural in STATUS_SUBRESOURCE:
+                            patch = {k: v for k, v in patch.items()
+                                     if k != "status"}
+                        merged = _merge7386(current, patch)
+                    updated = server._commit(plural, ns, name, merged)
+                return self._reply(200, updated)
+
+            def do_DELETE(self):
+                server.requests.append(("DELETE", self.path))
+                route = self._route()
+                if route is None or not route[3]:
+                    return self._status(404, "NotFound", f"no route {self.path}")
+                _group, ns, plural, name, _sub, _params = route
+                with server._lock:
+                    obj = server._delete(plural, ns, name)
+                if obj is None:
+                    return self._status(
+                        404, "NotFound", f'{plural} "{name}" not found')
+                return self._reply(200, obj)  # apiserver returns the object
+
+            # -- subresources -------------------------------------------
+
+            def _bind(self, ns, name, body):
+                target = (body.get("target") or {}).get("name", "")
+                if not target:
+                    return self._status(400, "Invalid", "target.name required")
+                with server._lock:
+                    pod = server._get("pods", ns, name)
+                    if pod is None:
+                        return self._status(404, "NotFound", "pod not found")
+                    if (pod.get("spec") or {}).get("nodeName"):
+                        return self._status(
+                            409, "Conflict",
+                            f'pod "{name}" is already assigned to node '
+                            f'"{pod["spec"]["nodeName"]}"')
+                    pod.setdefault("spec", {})["nodeName"] = target
+                    server._commit("pods", ns, name, pod)
+                return self._reply(201, {"kind": "Status", "code": 201,
+                                         "status": "Success"})
+
+            def _evict(self, ns, name):
+                """Real PDB semantics: block the eviction if any matching
+                budget would drop below minAvailable healthy pods."""
+                with server._lock:
+                    pod = server._get("pods", ns, name)
+                    if pod is None:
+                        return self._status(404, "NotFound", "pod not found")
+                    labels = (pod.get("metadata") or {}).get("labels") or {}
+                    for pdb in server._store.get(
+                            ("poddisruptionbudgets", ns or "default"),
+                            {}).values():
+                        spec = pdb.get("spec") or {}
+                        sel = ((spec.get("selector") or {})
+                               .get("matchLabels") or {})
+                        if any(labels.get(k) != v for k, v in sel.items()):
+                            continue
+                        healthy = sum(
+                            1 for p in server._store.get(
+                                ("pods", ns or "default"), {}).values()
+                            if all(((p.get("metadata") or {}).get("labels")
+                                    or {}).get(k) == v
+                                   for k, v in sel.items())
+                            and (p.get("status") or {}).get("phase")
+                            == "Running"
+                        )
+                        min_avail = spec.get("minAvailable", 0)
+                        if healthy - 1 < min_avail:
+                            return self._status(
+                                429, "TooManyRequests",
+                                "Cannot evict pod as it would violate the "
+                                "pod's disruption budget.")
+                    server._delete("pods", ns, name)
+                return self._reply(201, {"kind": "Status", "code": 201,
+                                         "status": "Success"})
+
+            # -- watch ---------------------------------------------------
+
+            def _chunk(self, data: bytes) -> None:
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            def _watch(self, plural, ns, params):
+                try:
+                    from_rv = int(params.get("resourceVersion") or 0)
+                except ValueError:
+                    from_rv = 0
+                q: "queue.Queue" = queue.Queue()
+                with server._lock:
+                    oldest_retained = (server._history[0][0]
+                                       if server._history else server._rev + 1)
+                    expired = (from_rv and server._history
+                               and from_rv < oldest_retained - 1)
+                    if not expired:
+                        for rev, eplural, evt in server._history:
+                            if eplural == plural and rev > from_rv:
+                                q.put(evt)
+                        server._watchers.append((plural, q))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    if expired:
+                        # too-old resourceVersion: one ERROR event, close
+                        # (client must relist) — API concepts "410 Gone"
+                        self._chunk(json.dumps({
+                            "type": "ERROR",
+                            "object": {
+                                "kind": "Status", "apiVersion": "v1",
+                                "status": "Failure", "reason": "Expired",
+                                "code": 410,
+                                "message": f"too old resource version: "
+                                           f"{from_rv}",
+                            },
+                        }).encode() + b"\n")
+                        self._chunk(b"")
+                        return
+                    while True:
+                        evt = q.get(timeout=30)
+                        obj_ns = ((evt["object"].get("metadata") or {})
+                                  .get("namespace"))
+                        if ns and obj_ns != ns:
+                            continue
+                        self._chunk(json.dumps(evt).encode() + b"\n")
+                except (queue.Empty, BrokenPipeError, ConnectionError,
+                        OSError):
+                    pass
+                finally:
+                    with server._lock:
+                        try:
+                            server._watchers.remove((plural, q))
+                        except ValueError:
+                            pass
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+
+    # -- store core (caller holds _lock) ----------------------------------
+
+    def _get(self, plural: str, ns: Optional[str], name: str) -> Optional[dict]:
+        return self._store.get((plural, ns or "default"), {}).get(name)
+
+    def _list(self, plural: str, ns: Optional[str],
+              params: Dict[str, str]) -> List[dict]:
+        buckets = ([self._store.get((plural, ns), {})] if ns else
+                   [v for (p, _), v in self._store.items() if p == plural])
+        items = [o for b in buckets for o in b.values()]
+        selector = params.get("labelSelector")
+        if selector:
+            items = [o for o in items if _match_selector(o, selector)]
+        field = params.get("fieldSelector")
+        if field and field.startswith("involvedObject.name="):
+            target = field.split("=", 1)[1]
+            items = [o for o in items
+                     if (o.get("involvedObject") or {}).get("name") == target]
+        return items
+
+    def _commit(self, plural: str, ns: Optional[str], name: str, obj: dict,
+                new: bool = False) -> dict:
+        ns = ns or (obj.get("metadata") or {}).get("namespace") or "default"
+        self._rev += 1
+        meta = obj.setdefault("metadata", {})
+        meta["namespace"] = meta.get("namespace") or ns
+        meta["resourceVersion"] = str(self._rev)
+        if new:
+            self._uid += 1
+            meta.setdefault("uid", f"strict-uid-{self._uid}")
+            meta.setdefault("creationTimestamp",
+                            time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+        existed = name in self._store.setdefault((plural, ns), {})
+        self._store[(plural, ns)][name] = obj
+        self._emit(plural, "MODIFIED" if existed and not new else "ADDED", obj)
+        return obj
+
+    def _delete(self, plural: str, ns: Optional[str], name: str) -> Optional[dict]:
+        obj = self._store.get((plural, ns or "default"), {}).pop(name, None)
+        if obj is not None:
+            self._rev += 1
+            obj["metadata"]["resourceVersion"] = str(self._rev)
+            self._emit(plural, "DELETED", obj)
+        return obj
+
+    def _emit(self, plural: str, etype: str, obj: dict) -> None:
+        evt = {"type": etype, "object": obj}
+        with self._lock:
+            self._history.append((self._rev, plural, evt))
+            del self._history[:-self._history_window]
+            targets = [q for p, q in self._watchers if p == plural]
+        for q in targets:
+            q.put(evt)
+
+    # -- lifecycle / test hooks (FakeApiServer-compatible surface) --------
+
+    def start(self) -> str:
+        self._thread.start()
+        host, port = self._httpd.server_address
+        return f"http://{host}:{port}"
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    def objects(self, plural: str, namespace: str = "default") -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._store.get((plural, namespace), {}))
+
+    def set_pod_status(self, namespace: str, name: str, status: dict) -> None:
+        with self._lock:
+            pod = self._get("pods", namespace, name)
+            if pod is None:
+                raise KeyError(name)
+            pod = dict(pod)
+            pod["status"] = status
+            self._commit("pods", namespace, name, pod)
+
+    def set_pod_log(self, namespace: str, name: str, text: str) -> None:
+        with self._lock:
+            pod = self._get("pods", namespace, name)
+            if pod is None:
+                raise KeyError(name)
+            pod["_log"] = text
+
+    def add_node(self, name: str, labels: Optional[dict] = None,
+                 allocatable: Optional[dict] = None) -> None:
+        with self._lock:
+            self._commit("nodes", None, name, {
+                "apiVersion": "v1", "kind": "Node",
+                "metadata": {"name": name, "labels": labels or {}},
+                "status": {"allocatable": allocatable or {}},
+            }, new=True)

@@ -1,0 +1,298 @@
+"""Apiserver conformance: one scenario battery, two independent fixtures.
+
+tests/fake_apiserver.py (the original home-grown fake) and
+tests/strict_apiserver.py (written independently from the Kubernetes API
+conventions, with real-apiserver behaviors the fake soft-pedals) both serve
+the same battery below through the REAL KubernetesCluster backend and
+controller.  A scenario passing on one and failing on the other means a
+shared-blind-spot assumption in runtime/k8s.py or a fixture bug — exactly
+the class of risk VERDICT r03 flagged for the k8s layer ("proven only
+against the home-grown fake").  kind/docker do not exist in this sandbox
+(see artifacts/ROUND4_NOTES.md), so this is the real-apiserver proxy tier.
+"""
+import threading
+import time
+
+import pytest
+
+from fake_apiserver import FakeApiServer
+from strict_apiserver import StrictApiServer
+from testutil import new_tpujob
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.core import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodGroup,
+    PodTemplateSpec,
+)
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.runtime.cluster import EvictionBlocked, NotFound
+from tf_operator_tpu.runtime.k8s import KubeConfig, KubernetesCluster
+from tf_operator_tpu.runtime.scheduler import GangScheduler
+
+SERVERS = {"fake": FakeApiServer, "strict": StrictApiServer}
+
+
+@pytest.fixture(params=sorted(SERVERS))
+def k8s(request):
+    server = SERVERS[request.param]()
+    url = server.start()
+    cluster = KubernetesCluster(
+        KubeConfig(host=url, namespace="default"), namespace="default"
+    )
+    yield server, cluster
+    cluster.close()
+    server.stop()
+
+
+def _wait(predicate, timeout=20.0, interval=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# the shared battery
+
+
+def test_job_crud_and_status_subresource(k8s):
+    server, cluster = k8s
+    job = new_tpujob(worker=2, name="conf-job")
+    job.metadata.uid = ""
+    created = cluster.create_job(job)
+    assert created.metadata.uid
+
+    from tf_operator_tpu.runtime import conditions
+
+    got = cluster.get_job("default", "conf-job")
+    conditions.update_job_conditions(
+        got.status, conditions.JobConditionType.RUNNING, "r", "m")
+    cluster.update_job_status("default", "conf-job", got.status)
+
+    # a main-resource update (label add) must not clobber status
+    got = cluster.get_job("default", "conf-job")
+    got.metadata.labels["touched"] = "yes"
+    cluster.update_job(got)
+    again = cluster.get_job("default", "conf-job")
+    assert again.metadata.labels["touched"] == "yes"
+    assert any(c.type.value == "Running" for c in again.status.conditions)
+
+    cluster.delete_job("default", "conf-job")
+    with pytest.raises(NotFound):
+        cluster.get_job("default", "conf-job")
+
+
+def test_controller_drives_job_to_succeeded(k8s):
+    """The full reconcile loop over the wire: job -> pods/services ->
+    kubelet-style status writes -> Succeeded condition + event."""
+    server, cluster = k8s
+    controller = TPUJobController(cluster)
+    job = new_tpujob(worker=2, ps=1, name="conf-e2e")
+    job.metadata.uid = ""
+    cluster.create_job(job)
+    controller.sync_job("default/conf-e2e")
+
+    pods = server.objects("pods")
+    assert sorted(pods) == [
+        "conf-e2e-ps-0", "conf-e2e-worker-0", "conf-e2e-worker-1"]
+    env = {e["name"]: e["value"]
+           for e in pods["conf-e2e-worker-0"]["spec"]["containers"][0]["env"]}
+    assert "TF_CONFIG" in env and '"worker"' in env["TF_CONFIG"]
+    assert len(server.objects("services")) == 3
+
+    done = {"phase": "Succeeded", "containerStatuses": [
+        {"name": "tensorflow", "state": {"terminated": {"exitCode": 0}}}]}
+    for name in ("conf-e2e-worker-0", "conf-e2e-worker-1"):
+        server.set_pod_status("default", name, done)
+    controller.sync_job("default/conf-e2e")
+    final = cluster.get_job("default", "conf-e2e")
+    assert any(c.type.value == "Succeeded" and c.status
+               for c in final.status.conditions), final.status.conditions
+    assert any(e.reason == "TPUJobSucceeded"
+               for e in cluster.list_events(object_name="conf-e2e"))
+
+
+def test_watch_streams_and_replays(k8s):
+    server, cluster = k8s
+    seen = []
+    lock = threading.Lock()
+
+    def handler(etype, pod):
+        with lock:
+            seen.append((etype.value, pod.metadata.name))
+
+    cluster.create_pod(Pod(
+        metadata=ObjectMeta(name="conf-pre"),
+        spec=PodTemplateSpec(containers=[Container(name="tensorflow",
+                                                   image="i")]),
+    ))
+    cluster.watch_pods(handler)
+    assert _wait(lambda: ("ADDED", "conf-pre") in seen)
+    cluster.create_pod(Pod(
+        metadata=ObjectMeta(name="conf-live"),
+        spec=PodTemplateSpec(containers=[Container(name="tensorflow",
+                                                   image="i")]),
+    ))
+    assert _wait(lambda: ("ADDED", "conf-live") in seen)
+    cluster.delete_pod("default", "conf-live")
+    assert _wait(lambda: ("DELETED", "conf-live") in seen)
+
+
+def test_lease_leader_election(k8s):
+    server, cluster = k8s
+    assert cluster.try_acquire_lease("conf-lock", "a", ttl=2.0)
+    assert not cluster.try_acquire_lease("conf-lock", "b", ttl=2.0)
+    assert cluster.try_acquire_lease("conf-lock", "a", ttl=2.0)  # renew
+    time.sleep(2.2)
+    assert cluster.try_acquire_lease("conf-lock", "b", ttl=2.0)  # expired
+
+
+def test_gang_binding_subresource(k8s):
+    server, cluster = k8s
+    server.add_node("conf-node", allocatable={constants.TPU_RESOURCE: "8"})
+    sched = GangScheduler(cluster, retry_interval=0.3)
+    try:
+        cluster.create_podgroup(PodGroup(
+            metadata=ObjectMeta(name="cg", namespace="default"), min_member=2))
+        for i in range(2):
+            cluster.create_pod(Pod(
+                metadata=ObjectMeta(
+                    name=f"cg-w-{i}", namespace="default",
+                    labels={constants.LABEL_REPLICA_INDEX: str(i)},
+                    annotations={constants.GANG_GROUP_ANNOTATION: "cg"},
+                ),
+                spec=PodTemplateSpec(
+                    containers=[Container(
+                        name="tensorflow", image="i",
+                        resources={constants.TPU_RESOURCE: 4.0})],
+                    scheduler_name=constants.GANG_SCHEDULER_NAME,
+                ),
+            ))
+        assert _wait(lambda: all(
+            (server.objects("pods")[f"cg-w-{i}"].get("spec") or {})
+            .get("nodeName") == "conf-node" for i in range(2)))
+    finally:
+        sched.close()
+
+
+def test_pod_patch_does_not_regress_status(k8s):
+    """update_pod is a metadata merge-patch; a status the kubelet advanced
+    between read and write must survive (the subresource contract)."""
+    server, cluster = k8s
+    pod = cluster.create_pod(Pod(
+        metadata=ObjectMeta(name="conf-patch"),
+        spec=PodTemplateSpec(containers=[Container(name="tensorflow",
+                                                   image="i")]),
+    ))
+    server.set_pod_status("default", "conf-patch", {
+        "phase": "Running",
+        "containerStatuses": [{"name": "tensorflow",
+                               "state": {"running": {}}}],
+    })
+    # stale snapshot (still Pending) + annotation write
+    pod.metadata.annotations["stamp"] = "v"
+    cluster.update_pod(pod)
+    got = cluster.get_pod("default", "conf-patch")
+    assert got.metadata.annotations["stamp"] == "v"
+    assert got.status.phase.value == "Running"  # not regressed to Pending
+
+
+# ---------------------------------------------------------------------------
+# strict-only contract points (the fake has no PDB math / small history)
+
+
+@pytest.fixture()
+def strict():
+    server = StrictApiServer(history_window=8)
+    url = server.start()
+    cluster = KubernetesCluster(
+        KubeConfig(host=url, namespace="default"), namespace="default"
+    )
+    yield server, cluster
+    cluster.close()
+    server.stop()
+
+
+def _mini_pod(name, labels=None):
+    return Pod(
+        metadata=ObjectMeta(name=name, labels=dict(labels or {})),
+        spec=PodTemplateSpec(containers=[Container(name="tensorflow",
+                                                   image="i")]),
+    )
+
+
+def test_eviction_blocked_by_real_pdb_math(strict):
+    server, cluster = strict
+    from tf_operator_tpu.api.core import PodDisruptionBudget
+
+    cluster.create_pdb(PodDisruptionBudget(
+        metadata=ObjectMeta(name="budget"),
+        min_available=2,
+        selector={"app": "gang"},
+    ))
+    for i in range(2):
+        cluster.create_pod(_mini_pod(f"ev-{i}", labels={"app": "gang"}))
+        server.set_pod_status("default", f"ev-{i}", {"phase": "Running"})
+    # 2 healthy, minAvailable=2: evicting any would violate the budget
+    with pytest.raises(EvictionBlocked):
+        cluster.evict_pod("default", "ev-0")
+    assert "ev-0" in server.objects("pods")
+    # a third healthy pod makes one eviction safe
+    cluster.create_pod(_mini_pod("ev-2", labels={"app": "gang"}))
+    server.set_pod_status("default", "ev-2", {"phase": "Running"})
+    cluster.evict_pod("default", "ev-0")
+    assert "ev-0" not in server.objects("pods")
+
+
+def test_watch_survives_410_expiry_via_relist(strict):
+    """history_window=8: a burst of writes expires any pinned
+    resourceVersion.  The watch layer must recover by relisting — handlers
+    end up with a complete, current picture (informer contract)."""
+    server, cluster = strict
+    state = {}
+    lock = threading.Lock()
+
+    def handler(etype, pod):
+        with lock:
+            if etype.value == "DELETED":
+                state.pop(pod.metadata.name, None)
+            else:
+                state[pod.metadata.name] = True
+
+    cluster.watch_pods(handler)
+    for i in range(30):  # >> history_window
+        cluster.create_pod(_mini_pod(f"burst-{i}"))
+    assert _wait(lambda: len(state) == 30, timeout=30)
+    cluster.delete_pod("default", "burst-0")
+    assert _wait(lambda: "burst-0" not in state, timeout=30)
+
+
+def test_cr_update_requires_resource_version(strict):
+    """The real apiserver rejects CR updates without metadata.resourceVersion;
+    update_job's read-inject-PUT must therefore always succeed, and a raw PUT
+    without one must fail (guards against the fake quietly accepting what
+    production rejects)."""
+    server, cluster = strict
+    job = new_tpujob(worker=1, name="rv-job")
+    job.metadata.uid = ""
+    cluster.create_job(job)
+    got = cluster.get_job("default", "rv-job")
+    got.metadata.labels["ok"] = "yes"
+    cluster.update_job(got)  # read-inject-PUT: fine
+    assert cluster.get_job("default", "rv-job").metadata.labels["ok"] == "yes"
+
+    from tf_operator_tpu.runtime.k8s import ApiError, job_to_k8s
+
+    body = job_to_k8s(got)
+    body["metadata"].pop("resourceVersion", None)
+    with pytest.raises(ApiError) as err:
+        cluster.client.request(
+            "PUT",
+            "/apis/tpu-operator.dev/v1/namespaces/default/tpujobs/rv-job",
+            body=body)
+    assert "must be specified" in str(err.value)

@@ -287,54 +287,48 @@ def test_no_partial_gang_when_one_member_infeasible(k8s, gang_sched):
     subset starting alone would be a partial gang."""
     server, cluster = k8s
     server.add_node("four-chip", allocatable={constants.TPU_RESOURCE: "4"})
-    sched = gang_sched(retry_interval=0.3)
-    try:
-        cluster.create_podgroup(PodGroup(
-            metadata=ObjectMeta(name="g7", namespace="default"), min_member=2,
-        ))
-        cluster.create_pod(_gang_pod("g7-worker-0", "g7", 0, tpu=4.0))
-        cluster.create_pod(_gang_pod("g7-worker-1", "g7", 1, tpu=4.0))
-        assert _wait(lambda: any(
-            e.reason == "FailedScheduling"
-            for e in cluster.list_events(object_name="g7-worker-1")))
-        assert not _node_of(server, "g7-worker-0")
-        assert not _node_of(server, "g7-worker-1")
-        assert not any(p.endswith("/binding") for _m, p in server.requests)
-        # the 0.3s retry sweep keeps attempting, but events are deduped —
-        # one FailedScheduling per pod per dry spell, not one per sweep
-        time.sleep(1.0)
-        assert len([e for e in cluster.list_events(object_name="g7-worker-1")
-                    if e.reason == "FailedScheduling"]) == 1
+    gang_sched(retry_interval=0.3)
+    cluster.create_podgroup(PodGroup(
+        metadata=ObjectMeta(name="g7", namespace="default"), min_member=2,
+    ))
+    cluster.create_pod(_gang_pod("g7-worker-0", "g7", 0, tpu=4.0))
+    cluster.create_pod(_gang_pod("g7-worker-1", "g7", 1, tpu=4.0))
+    assert _wait(lambda: any(
+        e.reason == "FailedScheduling"
+        for e in cluster.list_events(object_name="g7-worker-1")))
+    assert not _node_of(server, "g7-worker-0")
+    assert not _node_of(server, "g7-worker-1")
+    assert not any(p.endswith("/binding") for _m, p in server.requests)
+    # the 0.3s retry sweep keeps attempting, but events are deduped —
+    # one FailedScheduling per pod per dry spell, not one per sweep
+    time.sleep(1.0)
+    assert len([e for e in cluster.list_events(object_name="g7-worker-1")
+                if e.reason == "FailedScheduling"]) == 1
 
-        # a second node makes the whole gang feasible; the sweep binds both
-        server.add_node("four-chip-b",
-                        allocatable={constants.TPU_RESOURCE: "4"})
-        assert _wait(lambda: _node_of(server, "g7-worker-0")
-                     and _node_of(server, "g7-worker-1"))
-    finally:
-        sched.close()
+    # a second node makes the whole gang feasible; the sweep binds both
+    server.add_node("four-chip-b",
+                    allocatable={constants.TPU_RESOURCE: "4"})
+    assert _wait(lambda: _node_of(server, "g7-worker-0")
+                 and _node_of(server, "g7-worker-1"))
 
 
 def test_retry_binds_after_node_appears(k8s, gang_sched):
     """Node churn produces no pod watch events; the periodic sweep must pick
     up a stranded-but-admitted gang once a feasible node exists."""
     server, cluster = k8s
-    sched = gang_sched(retry_interval=0.3)
-    try:
-        cluster.create_podgroup(PodGroup(
-            metadata=ObjectMeta(name="g4", namespace="default"), min_member=1,
-        ))
-        cluster.create_pod(_gang_pod("g4-worker-0", "g4", 0, tpu=4.0))
-        assert _wait(lambda: any(
-            e.reason == "FailedScheduling"
-            for e in cluster.list_events(object_name="g4-worker-0")))
-        assert not _node_of(server, "g4-worker-0")
+    gang_sched(retry_interval=0.3)
+    cluster.create_podgroup(PodGroup(
+        metadata=ObjectMeta(name="g4", namespace="default"), min_member=1,
+    ))
+    cluster.create_pod(_gang_pod("g4-worker-0", "g4", 0, tpu=4.0))
+    assert _wait(lambda: any(
+        e.reason == "FailedScheduling"
+        for e in cluster.list_events(object_name="g4-worker-0")))
+    assert not _node_of(server, "g4-worker-0")
 
-        server.add_node("late-node",
-                        allocatable={constants.TPU_RESOURCE: "8"})
-        assert _wait(lambda: _node_of(server, "g4-worker-0") == "late-node")
-    finally:
-        sched.close()
+    server.add_node("late-node",
+                    allocatable={constants.TPU_RESOURCE: "8"})
+    assert _wait(lambda: _node_of(server, "g4-worker-0") == "late-node")
 
 
 def test_terminal_pods_release_node_capacity(k8s, gang_sched):
@@ -342,27 +336,24 @@ def test_terminal_pods_release_node_capacity(k8s, gang_sched):
     permanently starve the node for every later gang."""
     server, cluster = k8s
     server.add_node("n0", allocatable={constants.TPU_RESOURCE: "4"})
-    sched = gang_sched(retry_interval=0.3)
-    try:
-        cluster.create_podgroup(PodGroup(
-            metadata=ObjectMeta(name="g5", namespace="default"), min_member=1,
-        ))
-        cluster.create_pod(_gang_pod("g5-worker-0", "g5", 0, tpu=4.0))
-        assert _wait(lambda: _node_of(server, "g5-worker-0") == "n0")
+    gang_sched(retry_interval=0.3)
+    cluster.create_podgroup(PodGroup(
+        metadata=ObjectMeta(name="g5", namespace="default"), min_member=1,
+    ))
+    cluster.create_pod(_gang_pod("g5-worker-0", "g5", 0, tpu=4.0))
+    assert _wait(lambda: _node_of(server, "g5-worker-0") == "n0")
 
-        server.set_pod_status("default", "g5-worker-0", {
-            "phase": "Succeeded",
-            "containerStatuses": [
-                {"name": "tensorflow", "state": {"terminated": {"exitCode": 0}}}
-            ],
-        })
-        cluster.create_podgroup(PodGroup(
-            metadata=ObjectMeta(name="g6", namespace="default"), min_member=1,
-        ))
-        cluster.create_pod(_gang_pod("g6-worker-0", "g6", 0, tpu=4.0))
-        assert _wait(lambda: _node_of(server, "g6-worker-0") == "n0")
-    finally:
-        sched.close()
+    server.set_pod_status("default", "g5-worker-0", {
+        "phase": "Succeeded",
+        "containerStatuses": [
+            {"name": "tensorflow", "state": {"terminated": {"exitCode": 0}}}
+        ],
+    })
+    cluster.create_podgroup(PodGroup(
+        metadata=ObjectMeta(name="g6", namespace="default"), min_member=1,
+    ))
+    cluster.create_pod(_gang_pod("g6-worker-0", "g6", 0, tpu=4.0))
+    assert _wait(lambda: _node_of(server, "g6-worker-0") == "n0")
 
 
 def test_controller_gang_pods_bind_end_to_end(k8s, gang_sched):

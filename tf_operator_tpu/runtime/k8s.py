@@ -13,7 +13,9 @@ Resource mapping:
   TPUJob      -> apis/tpu-operator.dev/v1 tpujobs (manifests/crd.yaml)
   Pod/Service/Event -> core v1
   PodGroup    -> apis/scheduling.volcano.sh/v1beta1 podgroups (the gang unit
-                 the reference stamps, vendor/.../common/pod.go:42-53)
+                 the reference stamps, vendor/.../common/pod.go:42-53), or
+                 the operator's own CRD group (TPU_PODGROUP_API) when the
+                 in-process gang scheduler is the consumer
   PodDisruptionBudget -> apis/policy/v1
   Lease       -> apis/coordination.k8s.io/v1 (leader election; the reference
                  uses an EndpointsLock, server.go:159-184 — Leases are the
@@ -837,9 +839,11 @@ class KubernetesCluster(ClusterInterface):
         used: Dict[str, float] = {}
         wanted = set(targets)
         raw_pods: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        live_uids = set()
         for other in self.client.request("GET", "/api/v1/pods").get("items", []):
             meta = other.get("metadata") or {}
             key = (meta.get("namespace", "default"), meta.get("name", ""))
+            live_uids.add(key + (meta.get("uid", ""),))
             if key in wanted:
                 raw_pods[key] = other
             ospec = other.get("spec") or {}
@@ -850,6 +854,11 @@ class KubernetesCluster(ClusterInterface):
                     "Succeeded", "Failed"):
                 continue
             used[node] = used.get(node, 0.0) + self._pod_tpu_request(ospec)
+        # Warned-set hygiene: entries are keyed by (ns, name, uid) so a
+        # deleted-and-recreated pod (same deterministic name, new uid) gets
+        # its own FailedScheduling event, and pruning against the live uid
+        # set bounds the set's size on a long-lived operator.
+        self._sched_warned &= live_uids
 
         # Phase 1 — place every member against the snapshot WITHOUT posting
         # anything.  If any live, unbound member has no feasible node, bind
@@ -891,9 +900,11 @@ class KubernetesCluster(ClusterInterface):
             # retry sweep re-runs this path indefinitely and must not mint
             # a fresh Event object every attempt.
             for namespace, name, selector, requested in infeasible:
-                if (namespace, name) in self._sched_warned:
+                uid = ((raw_pods.get((namespace, name)) or {})
+                       .get("metadata") or {}).get("uid", "")
+                if (namespace, name, uid) in self._sched_warned:
                     continue
-                self._sched_warned.add((namespace, name))
+                self._sched_warned.add((namespace, name, uid))
                 self.record_event(Event(
                     object_kind="Pod", object_name=name, namespace=namespace,
                     event_type="Warning", reason="FailedScheduling",
@@ -914,7 +925,9 @@ class KubernetesCluster(ClusterInterface):
                     "target": {"apiVersion": "v1", "kind": "Node", "name": target},
                 },
             )
-            self._sched_warned.discard((namespace, name))
+            uid = ((raw_pods.get((namespace, name)) or {})
+                   .get("metadata") or {}).get("uid", "")
+            self._sched_warned.discard((namespace, name, uid))
 
     # -- services --
 
@@ -1210,7 +1223,20 @@ class KubernetesCluster(ClusterInterface):
     def close(self) -> None:
         self._stop.set()
         # Unblock watch threads parked in recv on timeout-less connections.
+        # shutdown() first: it wakes a blocked recv with EOF from another
+        # thread, whereas conn.close() alone can DEADLOCK — the watch thread
+        # holds the response buffer lock inside read1() (chunked decoding),
+        # and HTTPConnection.close() -> response.close() -> fp.close() blocks
+        # acquiring that same lock.
+        import socket as _socket
+
         for conn in list(self._watch_conns):
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
             try:
                 conn.close()
             except OSError:
