@@ -162,6 +162,7 @@ def _cpu_fallback_env():
     the platform in-process via TPUJOB_FORCE_PLATFORM."""
     return {
         "TPUJOB_FORCE_PLATFORM": "cpu",
+        "BENCH_WINDOWS": "5",  # 5 interleaved fw/bare pairs: tighter median
         "BENCH_IMAGE": "64",
         "BENCH_SEQ": "256",
         "BENCH_STEPS": "6",
@@ -385,11 +386,9 @@ def _tree_scalar(tree):
     return sum(leaves) if leaves else jnp.float32(0)
 
 
-def _steps_per_sec(raw_step, state, batch, steps: int, windows: int):
-    """Median steps/sec over `windows` timed runs of `raw_step` scanned
-    inside one jit, synced via device_get; returns (median, [window sps])."""
-    import statistics
-
+def _window_timer(raw_step, state, batch, steps: int):
+    """Compile `raw_step` scanned `steps` times inside one jit and return a
+    zero-arg closure timing one window (device_get-synced steps/sec)."""
     import jax
     from jax import lax
 
@@ -406,13 +405,14 @@ def _steps_per_sec(raw_step, state, batch, steps: int, windows: int):
 
     loss, chk = run(state)  # compile + first run
     jax.device_get((loss, chk))
-    sps = []
-    for _ in range(windows):
+
+    def time_once() -> float:
         t0 = time.perf_counter()
-        loss, chk = run(state)
-        jax.device_get((loss, chk))
-        sps.append(steps / (time.perf_counter() - t0))
-    return statistics.median(sps), sps
+        out = run(state)
+        jax.device_get(out)
+        return steps / (time.perf_counter() - t0)
+
+    return time_once
 
 
 def child_throughput() -> None:
@@ -556,28 +556,49 @@ def child_throughput() -> None:
     def pct_spread(ws):
         return round(100.0 * (max(ws) - min(ws)) / max(ws), 2)
 
-    fw_sps, fw_windows = _steps_per_sec(
-        lambda s, b: fw_raw(s, b), state, batch, steps, windows)
+    import statistics
+
+    # Interleaved arms: host load and thermal drift move THROUGHPUT over a
+    # run, so timing all fw windows then all bare windows biases whichever
+    # arm runs first (BENCH_r03's CPU LM "6.5% framework tax" was exactly
+    # this artifact — fw windows decayed 1600->850 tokens/s under a
+    # concurrent load while bare held steady).  Pairing fw/bare windows
+    # back-to-back exposes both arms to the same instantaneous conditions;
+    # vs_baseline is the median of per-pair ratios, which cancels drift.
+    fw_timer = _window_timer(lambda s, b: fw_raw(s, b), state, batch, steps)
+    fw_first = fw_timer()
     out = {
         "metric": metric,
-        "value": round(fw_sps * per_step, 2),
+        "value": round(fw_first * per_step, 2),
         "unit": unit,
         "vs_baseline": None,
         "windows": windows,
-        "fw_windows_per_sec": [round(w * per_step, 2) for w in fw_windows],
-        "fw_spread_pct": pct_spread(fw_windows),
+        "fw_windows_per_sec": [round(fw_first * per_step, 2)],
     }
     # Emit the framework arm as soon as it lands: if the flaky tunnel
     # wedges during the bare arm, the parent's _last_json still gets a
     # usable partial (vs_baseline absent, flagged) instead of nothing.
     print(json.dumps({**out, "partial": "bare arm not yet measured"}),
           flush=True)
-    bare_sps, bare_windows = _steps_per_sec(
-        bare_raw, bare_state, batch, steps, windows)
+    bare_timer = _window_timer(bare_raw, bare_state, batch, steps)
+    # fw_first is for the early partial only — it was taken before the bare
+    # arm's (long) compile, so pairing it with a bare window would span that
+    # gap and re-admit the drift bias.  Every counted pair is back-to-back.
+    fw_windows, bare_windows, ratios = [], [], []
+    for _ in range(windows):
+        fw_windows.append(fw_timer())
+        bare_windows.append(bare_timer())
+        ratios.append(fw_windows[-1] / bare_windows[-1])
+    fw_sps = statistics.median(fw_windows)
+    bare_sps = statistics.median(bare_windows)
     out.update(
-        vs_baseline=round(fw_sps / bare_sps, 4),
+        value=round(fw_sps * per_step, 2),
+        vs_baseline=round(statistics.median(ratios), 4),
+        fw_windows_per_sec=[round(w * per_step, 2) for w in fw_windows],
+        fw_spread_pct=pct_spread(fw_windows),
         bare_windows_per_sec=[round(w * per_step, 2) for w in bare_windows],
         bare_spread_pct=pct_spread(bare_windows),
+        pair_ratios=[round(r, 4) for r in ratios],
     )
     if model_kind == "lm" and mfu_of is not None:
         from tf_operator_tpu.ops.attention import _on_tpu

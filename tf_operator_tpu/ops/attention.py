@@ -515,46 +515,52 @@ def _on_tpu() -> bool:
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_tpu(q, k, v, causal=True, scale=None,
+                         block_q=128, block_k=128):
+    """The custom-vjp'd kernel path; flash_attention only routes here when
+    _on_tpu() — no fallback branch, so a refactor that reaches this off-TPU
+    fails loudly instead of silently paying the remat tax."""
+    check_gqa(q, k)
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k,
+                            interpret=False, save_lse=False)
+    return out
+
+
 def flash_attention(q, k, v, causal=True, scale=None, block_q=128, block_k=128):
     """Fused attention; Pallas kernels (fwd + bwd) on TPU, XLA elsewhere.
     k/v may carry fewer (grouped-query) heads than q — the kernels never
-    repeat them in HBM; the XLA fallback widens them explicitly."""
-    check_gqa(q, k)
-    s = scale if scale is not None else q.shape[-1] ** -0.5
-    if _on_tpu():
-        out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k,
-                                interpret=False, save_lse=False)
-        return out
-    return xla_attention(q, *repeat_kv(q, k, v), causal=causal, scale=s)
+    repeat them in HBM; the XLA fallback widens them explicitly.
+
+    The platform dispatch happens OUTSIDE the custom_vjp: off-TPU the
+    fallback runs plain xla_attention under standard autodiff.  Routing it
+    through the kernel's custom_vjp would recompute the whole forward inside
+    the backward (flash attention's memory-for-FLOPs remat trade) with no
+    memory payoff — a measurable pure-overhead tax on the CPU arm
+    (bench.py's CPU LM vs_baseline read ~0.97 from exactly this)."""
+    if not _on_tpu():
+        check_gqa(q, k)
+        s = scale if scale is not None else q.shape[-1] ** -0.5
+        return xla_attention(q, *repeat_kv(q, k, v), causal=causal, scale=s)
+    return _flash_attention_tpu(q, k, v, causal, scale, block_q, block_k)
 
 
 def _fwd(q, k, v, causal, scale, block_q, block_k):
     check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
-    if _on_tpu():
-        out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
-                                  interpret=False)
-        return out, (q, k, v, out, lse)
-    out = xla_attention(q, *repeat_kv(q, k, v), causal=causal, scale=s)
-    return out, (q, k, v, None, None)
+    out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
+                              interpret=False)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, scale, block_q, block_k, res, g):
     q, k, v, o, lse = res
     s = scale if scale is not None else q.shape[-1] ** -0.5
-    if lse is not None:
-        return _flash_backward(q, k, v, o, lse, g, s, causal,
-                               block_q, block_k, interpret=False)
-    _, vjp = jax.vjp(
-        lambda q, k, v: xla_attention(
-            q, *repeat_kv(q, k, v), causal=causal, scale=s
-        ),
-        q, k, v,
-    )
-    return vjp(g)
+    return _flash_backward(q, k, v, o, lse, g, s, causal,
+                           block_q, block_k, interpret=False)
 
 
-flash_attention.defvjp(_fwd, _bwd)
+_flash_attention_tpu.defvjp(_fwd, _bwd)
 
 
 # ---------------------------------------------------------------------------

@@ -33,6 +33,14 @@ from .cluster import EventType, InMemoryCluster
 # per-process cluster counter; feeds the default port-range spreading
 _CLUSTER_SEQ = itertools.count()
 
+# Ports handed to one cluster's replicas all come from a block of this many
+# contiguous ports; the block's first port is bound as a claim marker so
+# concurrent clusters (any process) collide at claim time, not at replica
+# rendezvous time.
+PORT_BLOCK = 512
+_PORT_FLOOR = 20000
+_PORT_CEILING = 32768  # Linux ephemeral range starts here; stay below
+
 log = tpulog.logger_for_key("local-cluster")
 
 
@@ -43,17 +51,20 @@ class LocalProcessCluster(InMemoryCluster):
         super().__init__()
         self.workdir = Path(workdir or ".tpujob-local")
         self.workdir.mkdir(parents=True, exist_ok=True)
+        self._port_marker = None
         if base_port is None:
             # Spread the default range by PID and per-process instance:
             # two clusters in different processes (concurrent pytest runs)
             # or sequential clusters in one process (a killed predecessor's
             # sockets may not be reaped yet) must not hand the same
             # 127.0.0.1 port to different jobs' coordinators — colliding
-            # groups rendezvous across tests and wedge.
-            # range stays below Linux's ephemeral ports (32768+) so no
-            # kernel-assigned outgoing connection can squat a replica port
+            # groups rendezvous across tests and wedge.  Hashing reduces but
+            # cannot rule out overlap, so probe-bind the block's first port
+            # and rehash on conflict; the block is capped at PORT_BLOCK
+            # ports below Linux's ephemeral range (32768+) so no
+            # kernel-assigned outgoing connection can squat a replica port.
             seed = os.getpid() * 2654435761 ^ next(_CLUSTER_SEQ) * 0x9E3779B9
-            base_port = 20000 + (seed >> 8) % 12000
+            base_port = self._claim_port_block(seed)
         self.base_port = base_port
         self.extra_env = dict(extra_env or {})
         # image -> (command, args): the "pulled image entrypoint" analogue.
@@ -76,10 +87,39 @@ class LocalProcessCluster(InMemoryCluster):
     def resolver(self, job: TPUJob, rtype: ReplicaType, index: int, port: int) -> str:
         return f"127.0.0.1:{self.port_for(job.metadata.name, rtype.value, index)}"
 
+    def _claim_port_block(self, seed: int) -> int:
+        """Pick a PORT_BLOCK-sized range and bind its first port as a claim
+        marker (held for the cluster's lifetime).  A bind conflict means
+        another live cluster hashed into the same block — rehash instead of
+        handing out ports that would cross-connect two jobs' coordinators."""
+        import socket as _socket
+
+        slots = (_PORT_CEILING - _PORT_FLOOR) // PORT_BLOCK
+        slot = (seed >> 8) % slots
+        for attempt in range(slots):
+            base = _PORT_FLOOR + ((slot + attempt) % slots) * PORT_BLOCK
+            marker = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+            try:
+                marker.bind(("127.0.0.1", base))
+            except OSError:
+                marker.close()
+                continue
+            marker.listen(1)
+            self._port_marker = marker
+            return base + 1  # replica ports follow the marker port
+        raise RuntimeError(
+            f"no free {PORT_BLOCK}-port block in "
+            f"[{_PORT_FLOOR}, {_PORT_CEILING})")
+
     def port_for(self, job_name: str, rtype: str, index: int) -> int:
         key = f"{job_name}/{rtype.lower()}/{index}"
         with self._port_lock:
             if key not in self._ports:
+                if len(self._ports) >= PORT_BLOCK - 1:
+                    raise RuntimeError(
+                        f"cluster exhausted its {PORT_BLOCK}-port block "
+                        f"(base {self.base_port}); raise PORT_BLOCK or use "
+                        "fewer replicas per cluster")
                 self._ports[key] = self.base_port + len(self._ports)
             return self._ports[key]
 
@@ -208,3 +248,10 @@ class LocalProcessCluster(InMemoryCluster):
                 except (ProcessLookupError, PermissionError):
                     pass
         self._procs.clear()
+        marker = getattr(self, "_port_marker", None)
+        if marker is not None:
+            self._port_marker = None
+            try:
+                marker.close()  # release the port-block claim
+            except OSError:
+                pass

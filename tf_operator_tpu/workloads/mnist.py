@@ -104,6 +104,11 @@ def main(argv=None) -> int:
         if (ckpt is not None and args.preempt_at_step is not None
                 and start_step < args.preempt_at_step == done):
             ckpt.save(state, step=done)
+            # stop an active profiler trace and drain the manager before
+            # exiting — a preemption combined with --profile-dir must not
+            # silently lose the requested trace
+            prof.close()
+            ckpt.close()
             print(f"preempted at step {done}, checkpoint saved", flush=True)
             return args.preempt_exit_code
         if ckpt is not None and args.save_every and done % args.save_every == 0:
