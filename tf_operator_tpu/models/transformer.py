@@ -36,9 +36,15 @@ class TransformerConfig:
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
     causal: bool = True
-    # Ring attention over this mesh axis when mesh is provided and the axis
-    # size > 1 (sequence sharded over ICI).
+    # Sequence parallelism over this mesh axis when mesh is provided and the
+    # axis size > 1 (sequence sharded over ICI).
     ring_axis: str = "sp"
+    # Strategy on that axis: "ring" rotates K/V blocks with ppermute
+    # (parallel/ring_attention.py, no head-count constraint); "ulysses"
+    # all-to-alls to head-sharding and runs full-sequence flash locally
+    # (parallel/ulysses.py, needs num_heads % sp == 0).  A config flip, not
+    # a rewrite — both consume the same sp-sharded activations.
+    seq_parallel: str = "ring"
     mesh: Optional[Any] = None  # jax.sharding.Mesh (static/hashable)
     remat: bool = False
     # False forces the O(T²) XLA attention path even on TPU — the bench's
@@ -74,6 +80,16 @@ class TransformerConfig:
             raise ValueError(f"norm must be 'layernorm'|'rmsnorm', got {self.norm!r}")
         if self.mlp not in ("gelu", "swiglu"):
             raise ValueError(f"mlp must be 'gelu'|'swiglu', got {self.mlp!r}")
+        if self.seq_parallel not in ("ring", "ulysses"):
+            raise ValueError(
+                f"seq_parallel must be 'ring'|'ulysses', got {self.seq_parallel!r}")
+        if (self.seq_parallel == "ulysses" and self.mesh is not None
+                and self.ring_axis in self.mesh.axis_names
+                and self.num_heads % self.mesh.shape[self.ring_axis]):
+            raise ValueError(
+                f"seq_parallel='ulysses' needs num_heads ({self.num_heads}) "
+                f"divisible by the {self.ring_axis!r} axis size "
+                f"({self.mesh.shape[self.ring_axis]}); use 'ring' instead")
         if self.use_rope and (self.d_model // self.num_heads) % 2:
             raise ValueError(
                 f"rope needs an even head_dim; d_model {self.d_model} / "
@@ -153,10 +169,21 @@ class SelfAttention(nn.Module):
             # in-kernel, and ring hops move the grouped blocks over ICI).
             # Only the plain XLA path needs the explicit widen.
             if _use_ring(cfg):
-                out = ring_attention(
-                    q, k, v, cfg.mesh, axis_name=cfg.ring_axis,
-                    causal=cfg.causal,
-                )
+                # use_flash rides through so the bench's XLA-baseline arm
+                # (use_flash=False) stays honest under sequence parallelism
+                # — otherwise flash-vs-XLA would measure flash vs flash.
+                if cfg.seq_parallel == "ulysses":
+                    from ..parallel.ulysses import ulysses_attention
+
+                    out = ulysses_attention(
+                        q, k, v, cfg.mesh, axis_name=cfg.ring_axis,
+                        causal=cfg.causal, use_flash=cfg.use_flash,
+                    )
+                else:
+                    out = ring_attention(
+                        q, k, v, cfg.mesh, axis_name=cfg.ring_axis,
+                        causal=cfg.causal, use_flash=cfg.use_flash,
+                    )
             elif cfg.use_flash:
                 out = flash_attention(q, k, v, cfg.causal)
             else:

@@ -1,0 +1,98 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second first-class long-context strategy next to ring attention
+(parallel/ring_attention.py).  Where the ring keeps queries resident and
+rotates K/V blocks hop by hop (N ppermute steps, O(T/N · T/N) score memory),
+Ulysses (DeepSpeed-Ulysses, arXiv:2309.14509) performs ONE all-to-all that
+re-shards activations from sequence-sharded [B, H, T/N, D] to head-sharded
+[B, H/N, T, D], runs full-sequence attention locally on the private heads
+(the Pallas flash kernel on TPU), and all-to-alls back.  Trade-offs:
+
+- collectives: 3 all-to-alls in + 1 out per attention vs N ppermutes —
+  fewer, larger transfers; on a TPU torus all-to-all rides ICI efficiently.
+- constraint: the head axes must divide by the sp axis size (ring has no
+  head constraint; it shards T only).
+- memory: full-T scores per private head — flash keeps that O(block·T), so
+  both strategies stay linear in T per device with the kernel.
+
+Which wins depends on interconnect and shape; the framework exposes both
+behind `TransformerConfig.seq_parallel = "ring" | "ulysses"` and the same
+`sp` mesh axis, so switching strategies is a config flip, not a rewrite.
+
+GQA: if kv heads also divide by sp they stay grouped end-to-end (each
+device attends its private query heads against its private kv heads —
+query-to-kv-group alignment is preserved because the all-to-all splits both
+head axes by the same factor in order).  If kv_heads < sp (can't split),
+K/V are widened to query heads first — correct, at repeat-in-HBM cost.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.attention import check_gqa, flash_attention, repeat_kv, xla_attention
+from .ring_attention import shard_map
+
+
+def _local_attend(q, k, v, causal: bool, scale: float, use_flash: bool):
+    if use_flash:
+        return flash_attention(q, k, v, causal, scale)
+    return xla_attention(q, *repeat_kv(q, k, v), causal=causal, scale=scale)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+    use_flash: bool = True,
+) -> jax.Array:
+    """Exact attention with the sequence axis sharded over `axis_name`,
+    exchanged to head-sharding for the local compute.
+
+    Inputs are global arrays [B, H, T, D] (sharded or to-be-sharded on T);
+    output matches q's shape/dtype.  Requires T % sp == 0 and
+    num_heads % sp == 0; grouped k/v heads must divide by sp too, else they
+    are widened to q's head count before the exchange.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    check_gqa(q, k)
+    sp = mesh.shape[axis_name]
+    b, h, t, d = q.shape
+    if h % sp:
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({h}) divisible by the "
+            f"{axis_name!r} axis size ({sp}); use ring attention for "
+            "head-count-constrained shapes")
+    if t % sp:
+        raise ValueError(f"sequence length {t} not divisible by {axis_name} "
+                         f"axis size {sp}")
+    if k.shape[1] % sp:
+        # kv group too small to split across sp: widen to MHA up front.
+        k, v = repeat_kv(q, k, v)
+
+    spec = P(None, None, axis_name, None)
+
+    def local(q_blk, k_blk, v_blk):
+        # [B, H, T/N, D] -> (split heads, gather sequence) -> [B, H/N, T, D]
+        qh, kh, vh = (
+            lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+            for x in (q_blk, k_blk, v_blk)
+        )
+        out = _local_attend(qh, kh, vh, causal, scale, use_flash)
+        # [B, H/N, T, D] -> (split sequence, gather heads) -> [B, H, T/N, D]
+        return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    return shard_map(
+        local, mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

@@ -1,6 +1,7 @@
 """Long-context LLM pretraining workload (BASELINE config 5 shape).
 
-Ring attention over the sp mesh axis for sequence scaling, tp param sharding,
+Sequence parallelism over the sp mesh axis (--seq-parallel ring|ulysses:
+ppermute K/V rotation or all-to-all head/seq exchange), tp param sharding,
 orbax checkpointing for preemption resume: on SIGTERM(143) the gang restarts
 (ExitCode policy) and this process picks up from the latest checkpoint —
 the TPU-native version of the reference's preemptible-TFJob story.
@@ -31,6 +32,11 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpoint-dir", default=None)
     parser.add_argument("--checkpoint-every", type=int, default=20)
     parser.add_argument("--remat", action="store_true")
+    parser.add_argument("--seq-parallel", choices=("ring", "ulysses"),
+                        default="ring",
+                        help="strategy on the sp mesh axis: ring (ppermute "
+                        "K/V rotation) or ulysses (all-to-all head/seq "
+                        "exchange; needs heads %% sp == 0)")
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="microbatches per optimizer step (activation "
                              "memory / N, same update math)")
@@ -137,8 +143,8 @@ def main(argv=None) -> int:
             vocab_size=args.vocab, num_layers=args.layers,
             num_heads=heads, d_model=args.d_model,
             d_ff=d_ff, max_len=args.seq_len,
-            mesh=mesh, ring_axis="sp", remat=args.remat,
-            moe_num_experts=args.moe_experts, **extra,
+            mesh=mesh, ring_axis="sp", seq_parallel=args.seq_parallel,
+            remat=args.remat, moe_num_experts=args.moe_experts, **extra,
         )
     except ValueError as e:
         # e.g. --arch llama with an odd derived head_dim: a CLI-input
