@@ -679,6 +679,36 @@ def child_attention() -> None:
             row["xla_error"] = repr(e)[:200]
         if flash_s and xla_s:  # ratio from raw timings, rounded for display
             row["speedup"] = round(xla_s / flash_s, 3)
+        # Tune-until-it-wins (VERDICT r03 #2): when the default 128x128
+        # tiling doesn't clearly beat XLA on chip, search block shapes and
+        # record the tuned number alongside.  "auto" gates on the observed
+        # ratio so flaky-window bench time is only spent where it matters;
+        # BENCH_ATTN_AUTOTUNE=1 forces the search, =0 disables it.
+        mode = os.environ.get("BENCH_ATTN_AUTOTUNE", "auto")
+        # "1" forces the search even off-TPU (autotune itself supports the
+        # fallback path, useful for exercising the plumbing); "auto" only
+        # spends chip time when the default tiling isn't clearly winning.
+        want_tune = (mode == "1" or (
+            mode == "auto" and _on_tpu() and flash_s and xla_s
+            and row.get("speedup", 99) < 1.05))
+        if want_tune:
+            try:
+                from tf_operator_tpu.ops.autotune import tune_flash_blocks
+
+                tuned = tune_flash_blocks(
+                    b, h, t, d, kv_h=kv_h, causal=True, reps=reps)
+                if "block_q" in tuned:
+                    row["tuned_blocks"] = [tuned["block_q"], tuned["block_k"]]
+                    flash_t = timed(lambda q, k, v: flash_attention(
+                        q, k, v, True, None,
+                        tuned["block_q"], tuned["block_k"]))
+                    row["flash_tuned_ms"] = round(flash_t * 1e3, 3)
+                    if xla_s:
+                        row["speedup_tuned"] = round(xla_s / flash_t, 3)
+                else:
+                    row["autotune_error"] = tuned.get("error", "")[:200]
+            except Exception as e:  # noqa: BLE001
+                row["autotune_error"] = repr(e)[:200]
         rows.append(row)
         # Emit after every row: a tunnel wedge mid-ladder keeps the rows
         # already measured (parent takes the last complete JSON line).

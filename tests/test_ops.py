@@ -325,3 +325,40 @@ class TestLseCompiledOnTPU:
             np.testing.assert_allclose(
                 np.asarray(a, np.float32), np.asarray(b, np.float32),
                 atol=0.1, rtol=0.1)
+
+
+class TestAutotune:
+    """ops/autotune.py machinery (CPU: all candidates time the XLA fallback,
+    so the value is in the plumbing — search, caching, env propagation)."""
+
+    def test_returns_best_and_caches(self, tmp_path, monkeypatch):
+        from tf_operator_tpu.ops import autotune
+
+        monkeypatch.setenv("TPUJOB_AUTOTUNE_CACHE",
+                           str(tmp_path / "tune.json"))
+        autotune._CACHE.clear()
+        result = autotune.tune_flash_blocks(
+            1, 2, 64, 8, reps=1, candidates=[(128, 128), (64, 64)])
+        # 128 > t=64 is filtered; the 64x64 candidate must win by default
+        assert result["block_q"] == 64 and result["block_k"] == 64
+        assert result["ms"] > 0
+        assert [e for e in result["table"] if "ms" in e]
+        # in-process cache: same signature returns the same object
+        again = autotune.tune_flash_blocks(
+            1, 2, 64, 8, reps=1, candidates=[(128, 128), (64, 64)])
+        assert again is result
+        # persistent cache: a fresh in-process cache loads from the file
+        autotune._CACHE.clear()
+        loaded = autotune.tune_flash_blocks(
+            1, 2, 64, 8, reps=1, candidates=[(128, 128), (64, 64)])
+        assert loaded == {k: v for k, v in result.items()}
+
+    def test_env_default_blocks(self, monkeypatch):
+        from tf_operator_tpu.ops.attention import default_blocks
+
+        assert default_blocks(None, None) == (128, 128)
+        assert default_blocks(256, None) == (256, 128)
+        monkeypatch.setenv("TPUJOB_FLASH_BLOCK_Q", "512")
+        monkeypatch.setenv("TPUJOB_FLASH_BLOCK_K", "256")
+        assert default_blocks(None, None) == (512, 256)
+        assert default_blocks(64, 64) == (64, 64)  # explicit args win

@@ -195,6 +195,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
     GQA: k/v may have kv_heads < heads; each query head reads kv head
     h // group through the k/v index maps (flattened: kv index b // group,
     exact because b = bi*H + h and H = Hkv*group)."""
+    block_q, block_k = default_blocks(block_q, block_k)
     batch, heads, real_len, head_dim = q.shape
     kv_heads = k.shape[1]
     group = heads // kv_heads
@@ -388,6 +389,7 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     into the existing row-scalar plumbing with no kernel change:
     ds = p·(dp − delta + dlse) = p·(dp − (delta − dlse)), since
     ∂lse_i/∂s_ij = p_ij — so the kernels just receive delta' = delta − dlse."""
+    block_q, block_k = default_blocks(block_q, block_k)
     batch, heads, real_len, head_dim = q.shape
     kv_heads = k.shape[1]
     group = heads // kv_heads
@@ -527,7 +529,41 @@ def _flash_attention_tpu(q, k, v, causal=True, scale=None,
     return out
 
 
-def flash_attention(q, k, v, causal=True, scale=None, block_q=128, block_k=128):
+def _env_block(name: str, multiple: int) -> int:
+    import os
+
+    raw = os.environ.get(name, "128")
+    try:
+        value = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{name}={raw!r} is not an integer (this env var is the "
+            "autotune propagation channel — see ops/autotune.py)") from None
+    if value <= 0 or value % multiple:
+        raise ValueError(
+            f"{name}={value} must be a positive multiple of {multiple} "
+            f"(Mosaic tiling: blocks are (mult-of-8, mult-of-128) tiles)")
+    return value
+
+
+def default_blocks(block_q, block_k):
+    """Resolve kernel block defaults: explicit args win; otherwise the
+    TPUJOB_FLASH_BLOCK_Q/K env (how autotuned configs reach workloads
+    without config plumbing — ops/autotune.py); otherwise 128.  Read at
+    trace time, so consistent within any one compiled program; a bad env
+    value fails here, naming the variable, not deep inside Mosaic.
+    Resolution lives ONLY at the _flash_forward/_flash_backward
+    chokepoints so every public entry (flash_attention,
+    flash_attention_lse, the interpret helpers) shares one rule."""
+    if block_q is None:
+        block_q = _env_block("TPUJOB_FLASH_BLOCK_Q", 8)
+    if block_k is None:
+        block_k = _env_block("TPUJOB_FLASH_BLOCK_K", 128)
+    return block_q, block_k
+
+
+def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
+                    block_k=None):
     """Fused attention; Pallas kernels (fwd + bwd) on TPU, XLA elsewhere.
     k/v may carry fewer (grouped-query) heads than q — the kernels never
     repeat them in HBM; the XLA fallback widens them explicitly.
@@ -590,7 +626,7 @@ def xla_attention_lse(q, k, v, *, causal: bool = True,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention_lse(q, k, v, causal=True, scale=None,
-                        block_q=128, block_k=128):
+                        block_q=None, block_k=None):
     """Fused attention returning (o, lse [B,H,T] f32); Pallas on TPU, XLA
     elsewhere.  Differentiable in BOTH outputs (the lse cotangent folds into
     the backward's delta term — see _flash_backward).  GQA k/v supported as

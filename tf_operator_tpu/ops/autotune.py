@@ -1,0 +1,175 @@
+"""Flash-kernel block-size autotuning.
+
+The Pallas kernels (ops/attention.py) take block_q/block_k grid parameters;
+128x128 is a reasonable static default for the v5e MXU/VMEM, but the best
+tiling depends on sequence length, head count, and dtype — and a wrong
+tiling can leave the kernel slower than stock XLA attention.  This module
+measures instead of guessing: it times compiled fwd+bwd at candidate block
+shapes on the CURRENT backend and returns the winner.
+
+Tuned blocks propagate two ways:
+
+- explicitly: `flash_attention(..., block_q=bq, block_k=bk)`;
+- ambiently: `TPUJOB_FLASH_BLOCK_Q` / `TPUJOB_FLASH_BLOCK_K` env vars, read
+  by `default_blocks()` in ops/attention.py when callers leave the block
+  arguments at their defaults — so a workload picks up a tuned config
+  without any plumbing through model/config layers (the env is read at
+  trace time, consistent within a compiled program).
+
+Results are cached in-process by shape signature and, when
+`TPUJOB_AUTOTUNE_CACHE` names a JSON file, across processes — the bench's
+attention ladder (bench.py child_attention) tunes automatically when the
+default tiling fails to beat XLA on chip and records both numbers.
+
+Candidates keep the Mosaic tiling contract: every block dimension is a
+multiple of (8, 128) for the (sublane, lane) axes — see
+/opt/skills/guides/pallas_guide.md and the round-2 lse BlockSpec bug.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+# (block_q, block_k) search space: powers of two in the lane-legal range.
+# 128 is the lane width; larger blocks amortize grid overhead but raise
+# VMEM pressure (block_q*d + block_k*d + block_q*block_k scratch).
+DEFAULT_CANDIDATES: List[Tuple[int, int]] = [
+    (128, 128), (256, 128), (128, 256), (256, 256),
+    (512, 128), (128, 512), (512, 256), (256, 512), (512, 512),
+]
+
+# shape signature -> result dict
+_CACHE: Dict[tuple, dict] = {}
+
+
+def _cache_path() -> Optional[str]:
+    return os.environ.get("TPUJOB_AUTOTUNE_CACHE") or None
+
+
+def _signature(backend, b, h, kv_h, t, d, causal, dtype,
+               candidates, reps) -> tuple:
+    # backend is part of the key: a CPU run times the XLA fallback (every
+    # candidate ties, winner is noise) and must never be served to a TPU
+    # run from a shared cache file; candidates/reps too — a result is only
+    # valid for the search it came from.
+    return (backend, b, h, kv_h, t, d, bool(causal), str(dtype),
+            tuple(map(tuple, candidates)), reps)
+
+
+def _load_persistent(sig: tuple) -> Optional[dict]:
+    path = _cache_path()
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            table = json.load(f)
+        return table.get(json.dumps(list(sig)))
+    except (OSError, ValueError):
+        return None
+
+
+def _store_persistent(sig: tuple, result: dict) -> None:
+    path = _cache_path()
+    if not path:
+        return
+    table = {}
+    try:
+        if os.path.exists(path):
+            with open(path) as f:
+                table = json.load(f)
+    except (OSError, ValueError):
+        table = {}
+    table[json.dumps(list(sig))] = result
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(table, f, indent=1)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
+def tune_flash_blocks(
+    b: int, h: int, t: int, d: int,
+    *,
+    kv_h: Optional[int] = None,
+    causal: bool = True,
+    dtype=None,
+    reps: int = 3,
+    candidates: Optional[List[Tuple[int, int]]] = None,
+) -> dict:
+    """Time compiled flash fwd+bwd per candidate block shape; return
+    {"block_q", "block_k", "ms", "table": [{"block_q","block_k","ms"|"error"}]}.
+
+    Runs on whatever backend is active — only meaningful on TPU (off-TPU the
+    public entry point bypasses the kernel entirely; this function times the
+    custom-vjp'd kernel path directly so CPU tests exercise the machinery).
+    Results are cached by shape signature (in-process + optional JSON file).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .attention import _flash_attention_tpu, _on_tpu, xla_attention
+
+    dtype = dtype or jnp.bfloat16
+    kv_h = kv_h or h
+    sig = _signature(jax.default_backend(), b, h, kv_h, t, d, causal,
+                     jnp.dtype(dtype).name, candidates or DEFAULT_CANDIDATES,
+                     reps)
+    if sig in _CACHE:
+        return _CACHE[sig]
+    persisted = _load_persistent(sig)
+    if persisted is not None:
+        _CACHE[sig] = persisted
+        return persisted
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, t, d)).astype(dtype)
+    k = jax.random.normal(kk, (b, kv_h, t, d)).astype(dtype)
+    v = jax.random.normal(kv, (b, kv_h, t, d)).astype(dtype)
+
+    if _on_tpu():
+        def attend(q, k, v, bq, bk):
+            return _flash_attention_tpu(q, k, v, causal, None, bq, bk)
+    else:
+        # Off-TPU there is no kernel to tune; time the fallback so the
+        # harness itself stays testable (all candidates tie, modulo noise).
+        def attend(q, k, v, bq, bk):
+            from .attention import repeat_kv
+
+            return xla_attention(q, *repeat_kv(q, k, v), causal=causal)
+
+    table = []
+    best = None
+    for bq, bk in candidates or DEFAULT_CANDIDATES:
+        if bq > t or bk > t:
+            continue
+        try:
+            grad = jax.jit(jax.grad(
+                lambda q, k, v, bq=bq, bk=bk: jnp.sum(
+                    attend(q, k, v, bq, bk).astype(jnp.float32)),
+                argnums=(0, 1, 2)))
+            out = grad(q, k, v)  # compile
+            jax.device_get([jnp.sum(x.astype(jnp.float32)) for x in out])
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = grad(q, k, v)
+            jax.device_get([jnp.sum(x.astype(jnp.float32)) for x in out])
+            ms = (time.perf_counter() - t0) / reps * 1e3
+            table.append({"block_q": bq, "block_k": bk, "ms": round(ms, 3)})
+            if best is None or ms < best[0]:
+                best = (ms, bq, bk)
+        except Exception as e:  # noqa: BLE001 — infeasible tiling (VMEM) is data
+            table.append({"block_q": bq, "block_k": bk,
+                          "error": repr(e)[:160]})
+    if best is None:
+        result = {"error": "no candidate compiled", "table": table}
+    else:
+        result = {"block_q": best[1], "block_k": best[2],
+                  "ms": round(best[0], 3), "table": table}
+    _CACHE[sig] = result
+    _store_persistent(sig, result)
+    return result
