@@ -377,3 +377,193 @@ def test_controller_gang_pods_bind_end_to_end(k8s, gang_sched):
         assert pod["spec"]["schedulerName"] == constants.GANG_SCHEDULER_NAME
         assert (pod["metadata"]["annotations"][constants.GANG_GROUP_ANNOTATION]
                 == "gjob")
+
+
+# ---------------------------------------------------------------------------
+# churn fuzz over the wire: the binding path under racing events
+
+
+class _K8sGangFuzz:
+    """Randomized job/node/pod churn against the REAL apiserver dialect with
+    the gang scheduler binding through pods/binding.  Unlike the InMemory
+    fuzz (test_gang_fuzz.py), watch delivery here is asynchronous, so the
+    harness checks SAFETY invariants on every server snapshot and LIVENESS
+    only at quiescence:
+
+      S1. no node overcommit: TPU requests of non-terminal pods bound to a
+          node never exceed its allocatable (the bind-lock race target)
+      S2. selector honored: no pod bound to a node failing its nodeSelector
+      S3. all-or-nothing per gang: a gang is never left partially bound
+          longer than the retry sweep period with no capacity change
+      L1. at quiescence with feasible capacity, every live gang is fully
+          bound
+    """
+
+    CHIPS = 4.0
+
+    def __init__(self, seed, server, cluster):
+        import random
+
+        self.rng = random.Random(seed)
+        self.server = server
+        self.cluster = cluster
+        self.controller = TPUJobController(
+            cluster, config=ReconcilerConfig(enable_gang_scheduling=True))
+        self.sched = GangScheduler(cluster, retry_interval=0.2)
+        self.jobs = {}
+        self.nodes = 0
+        self.counter = 0
+
+    def close(self):
+        self.sched.close()
+
+    # ops ---------------------------------------------------------------
+
+    def op_add_node(self):
+        if self.nodes >= 4:
+            return
+        self.nodes += 1
+        self.server.add_node(
+            f"fz-node-{self.nodes}",
+            allocatable={constants.TPU_RESOURCE: "8"})
+
+    def op_create_job(self):
+        if len(self.jobs) >= 3:
+            return
+        self.counter += 1
+        name = f"fzk-{self.counter}"
+        job = new_tpujob(worker=self.rng.choice([1, 2]), name=name)
+        from tf_operator_tpu.api.types import ReplicaType
+
+        spec = job.spec.replica_specs[ReplicaType.WORKER]
+        for c in spec.template.containers:
+            c.resources = {constants.TPU_RESOURCE: self.CHIPS}
+        job.metadata.uid = ""
+        self.cluster.create_job(job)
+        self.jobs[name] = int(spec.replicas or 1)
+
+    def op_delete_job(self):
+        if not self.jobs:
+            return
+        name = self.rng.choice(sorted(self.jobs))
+        try:
+            self.cluster.delete_job("default", name)
+        except Exception:
+            pass
+        # cascade like the k8s GC (owner refs) so capacity frees
+        for pod_name, pod in self.server.objects("pods").items():
+            owner = ((pod.get("metadata") or {}).get("ownerReferences")
+                     or [{}])[0]
+            if owner.get("name") == name:
+                try:
+                    self.cluster.delete_pod("default", pod_name)
+                except Exception:
+                    pass
+        del self.jobs[name]
+
+    def op_complete_gang(self):
+        """Flip one job's bound pods to Succeeded (kubelet sim)."""
+        if not self.jobs:
+            return
+        name = self.rng.choice(sorted(self.jobs))
+        done = {"phase": "Succeeded", "containerStatuses": [
+            {"name": "tensorflow", "state": {"terminated": {"exitCode": 0}}}]}
+        for pod_name, pod in self.server.objects("pods").items():
+            if pod_name.startswith(f"{name}-") and (
+                    pod.get("spec") or {}).get("nodeName"):
+                try:
+                    self.server.set_pod_status("default", pod_name, done)
+                except KeyError:
+                    pass
+
+    def op_sync(self):
+        for name in sorted(self.jobs):
+            try:
+                self.controller.sync_job(f"default/{name}")
+            except Exception:
+                pass
+
+    def step(self):
+        op = self.rng.choice([
+            self.op_add_node, self.op_create_job, self.op_delete_job,
+            self.op_complete_gang, self.op_sync, self.op_sync,
+        ])
+        op()
+        self.op_sync()
+        time.sleep(0.05)
+        self.check_safety()
+
+    # invariants --------------------------------------------------------
+
+    def _snapshot(self):
+        pods = self.server.objects("pods")
+        nodes = self.server.objects("nodes")
+        return pods, nodes
+
+    def check_safety(self):
+        pods, nodes = self._snapshot()
+        allocatable = {
+            n: float((node.get("status") or {}).get("allocatable", {})
+                     .get(constants.TPU_RESOURCE, 0))
+            for n, node in nodes.items()
+        }
+        used = {}
+        for name, pod in pods.items():
+            spec = pod.get("spec") or {}
+            node = spec.get("nodeName")
+            phase = (pod.get("status") or {}).get("phase")
+            if not node or phase in ("Succeeded", "Failed"):
+                continue
+            req = sum(
+                float(((c.get("resources") or {}).get("limits") or {})
+                      .get(constants.TPU_RESOURCE, 0))
+                for c in spec.get("containers") or [])
+            used[node] = used.get(node, 0.0) + req
+            # S2
+            selector = spec.get("nodeSelector") or {}
+            labels = ((nodes.get(node) or {}).get("metadata") or {}
+                      ).get("labels") or {}
+            assert all(labels.get(k) == v for k, v in selector.items()), (
+                f"pod {name} bound to {node} violating selector {selector}")
+        for node, amount in used.items():
+            # S1 — the overcommit invariant the bind lock exists for
+            assert amount <= allocatable.get(node, 0) + 1e-9, (
+                f"node {node} overcommitted: {amount} > "
+                f"{allocatable.get(node)} (pods: "
+                f"{[n for n, p in pods.items() if (p.get('spec') or {}).get('nodeName') == node]})")
+
+    def check_quiescent(self):
+        """L1 + S3: with ample capacity, every live gang fully bound."""
+        def settled():
+            pods, _ = self._snapshot()
+            by_job = {}
+            for name, pod in pods.items():
+                phase = (pod.get("status") or {}).get("phase")
+                if phase in ("Succeeded", "Failed"):
+                    continue
+                job = ((pod.get("metadata") or {}).get("ownerReferences")
+                       or [{}])[0].get("name", "?")
+                by_job.setdefault(job, []).append(
+                    bool((pod.get("spec") or {}).get("nodeName")))
+            return all(all(v) for v in by_job.values() if v)
+
+        assert _wait(settled, timeout=30), "gangs never fully bound"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(3))
+def test_gang_churn_fuzz_over_k8s(k8s, seed):
+    server, cluster = k8s
+    # enough fabric that every surviving gang is eventually feasible
+    for i in range(2):
+        server.add_node(f"base-node-{i}",
+                        allocatable={constants.TPU_RESOURCE: "8"})
+    fuzz = _K8sGangFuzz(seed, server, cluster)
+    try:
+        for _ in range(40):
+            fuzz.step()
+        fuzz.op_sync()
+        fuzz.check_quiescent()
+        fuzz.check_safety()
+    finally:
+        fuzz.close()
