@@ -41,9 +41,17 @@ VOLCANO_PODGROUP_PATH = (
 )
 
 
-@pytest.fixture()
-def k8s():
-    server = FakeApiServer()
+@pytest.fixture(params=["fake", "strict"])
+def k8s(request):
+    """Every gang-over-k8s scenario runs against BOTH apiserver fixtures —
+    the strict one (tests/strict_apiserver.py) additionally enforces 409 on
+    double-binding, resourceVersion rules, and chunked watch streams."""
+    if request.param == "strict":
+        from strict_apiserver import StrictApiServer
+
+        server = StrictApiServer()
+    else:
+        server = FakeApiServer()
     url = server.start()
     cluster = KubernetesCluster(
         KubeConfig(host=url, namespace="default"), namespace="default"
