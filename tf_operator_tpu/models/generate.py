@@ -23,20 +23,45 @@ from .transformer import TransformerConfig, TransformerLM
 def _decode_variant(cfg: TransformerConfig) -> TransformerConfig:
     """The decode twin of a training config: same architecture/params,
     cache-backed attention, no flash/ring (a decode step is a GEMV —
-    the O(T²) kernels have nothing to fuse)."""
+    the O(T²) kernels have nothing to fuse).  mesh is stripped from the
+    MODULE config (decode attention never dispatches on it); sharded
+    generation still works — jit follows the input shardings of the
+    tp/fsdp-sharded params (GSPMD), and generate() shards the cache."""
     return dataclasses.replace(cfg, decode=True, use_flash=False, mesh=None)
 
 
-def _fresh_cache(model: TransformerLM, batch: int):
+def _cache_sharding(mesh, leaf_shape):
+    """Sharding for one cache leaf under tp inference.  K/V caches are
+    [batch, kv_heads, max_len, head_dim]: the kv-head axis shards over tp
+    (matching the column-parallel k/v projections, so cache writes stay
+    local to the head shard); anything else (the scalar cache index)
+    replicates.  Axes that don't divide evenly replicate, mirroring
+    parallel/tp_rules.py's fallback."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if (len(leaf_shape) == 4 and "tp" in mesh.axis_names
+            and leaf_shape[1] % mesh.shape["tp"] == 0):
+        return NamedSharding(mesh, P(None, "tp", None, None))
+    return NamedSharding(mesh, P())
+
+
+def _fresh_cache(model: TransformerLM, batch: int, mesh=None):
     """All-zero cache pytree (zero index == empty) with the right shapes,
-    discovered via eval_shape so no device work happens."""
+    discovered via eval_shape so no device work happens; sharded over
+    `mesh` when given so a tp-sharded model's cache memory scales too."""
     shapes = jax.eval_shape(
         lambda: model.init(
             jax.random.PRNGKey(0), jnp.zeros((batch, 1), jnp.int32)
         )
     )["cache"]
+    if mesh is None:
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes
+        )
     return jax.tree_util.tree_map(
-        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+        lambda s: jax.device_put(
+            jnp.zeros(s.shape, s.dtype), _cache_sharding(mesh, s.shape)),
+        shapes,
     )
 
 
@@ -110,7 +135,7 @@ def generate(cfg: TransformerConfig, params, prompt, max_new_tokens: int,
 
     model, prefill, step = _decode_fns(
         _decode_variant(cfg), float(temperature), int(top_k))
-    cache = _fresh_cache(model, batch)
+    cache = _fresh_cache(model, batch, mesh=cfg.mesh)
 
     keys = (
         jax.random.split(rng, max_new_tokens)
