@@ -220,27 +220,43 @@ def _throughput(platform, stages, model):
 
 
 def _attention_ladder(platform, stages):
-    """Compiled flash-vs-XLA fwd+bwd wall time over a seq-length ladder."""
+    """Compiled flash-vs-XLA fwd+bwd wall time over a seq-length ladder,
+    then a shorter grouped-query arm (kv_heads = heads/3) pricing the
+    GQA-native kernel path against the widen-in-HBM XLA approach."""
     if os.environ.get("BENCH_SKIP_ATTENTION"):
         return None
-    env = {} if platform is not None else dict(
-        TPUJOB_FORCE_PLATFORM="cpu", BENCH_ATTN_SEQS="256,512")
-    t0 = time.time()
-    rc, out, err = _run(
-        [sys.executable, os.path.abspath(__file__), "--child-attention"],
-        env, CHILD_TIMEOUT,
-    )
-    parsed = _last_json(out)
-    stages.append({"stage": "attention", "rc": rc,
-                   "sec": round(time.time() - t0, 1),
-                   "ok": parsed is not None,
-                   **({} if parsed else {"err": err[-300:]})})
-    if parsed is not None and rc != 0:
-        # rows measured before the child died (timeout or crash), but the
-        # ladder is truncated — must not read as a complete run
-        parsed["partial_rc"] = rc
-        parsed["partial"] = "ladder truncated by child exit"
-    return parsed
+
+    def run_child(tag, extra_env, timeout=CHILD_TIMEOUT):
+        env = {} if platform is not None else dict(
+            TPUJOB_FORCE_PLATFORM="cpu", BENCH_ATTN_SEQS="256,512")
+        env.update(extra_env)
+        t0 = time.time()
+        rc, out, err = _run(
+            [sys.executable, os.path.abspath(__file__), "--child-attention"],
+            env, timeout,
+        )
+        parsed = _last_json(out)
+        stages.append({"stage": tag, "rc": rc,
+                       "sec": round(time.time() - t0, 1),
+                       "ok": parsed is not None,
+                       **({} if parsed else {"err": err[-300:]})})
+        if parsed is not None and rc != 0:
+            # rows measured before the child died (timeout or crash), but
+            # the ladder is truncated — must not read as a complete run
+            parsed["partial_rc"] = rc
+            parsed["partial"] = "ladder truncated by child exit"
+        return parsed
+
+    parsed = run_child("attention", {})
+    # GQA arm: fewer rungs so a flaky live window still covers it.
+    gqa_env = {"BENCH_ATTN_KV_H": "4"}
+    if platform is not None:
+        gqa_env["BENCH_ATTN_SEQS"] = os.environ.get(
+            "BENCH_ATTN_GQA_SEQS", "1024,4096")
+    gqa = run_child("attention:gqa", gqa_env)
+    if parsed is not None and gqa is not None:
+        parsed["gqa_arm"] = gqa
+    return parsed if parsed is not None else gqa
 
 
 def _control_plane(stages):

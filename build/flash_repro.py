@@ -10,11 +10,18 @@ not.  Capture: `python build/flash_repro.py 2>&1 | tee artifacts/flash_repro_<st
 """
 import sys
 
-import numpy as np
-import jax
-import jax.numpy as jnp
-
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+# TPUJOB_FORCE_PLATFORM=cpu lets the script run off-chip (fallback-path
+# smoke); without it, importing jax dials the tunneled TPU plugin — which
+# HANGS when the tunnel is wedged, so only run bare on a live chip.
+from tf_operator_tpu.workloads.runner import apply_forced_platform  # noqa: E402
+
+apply_forced_platform()
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
 
 from tf_operator_tpu.ops.attention import flash_attention, xla_attention  # noqa: E402
 

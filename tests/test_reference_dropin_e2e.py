@@ -156,9 +156,13 @@ def test_dist_mnist_yaml_runs_unmodified(local_stack):
     client.wait_for_job("dist-mnist-for-e2e-test", timeout=300)
     logs = client.get_logs("dist-mnist-for-e2e-test")
     assert client.is_job_succeeded("dist-mnist-for-e2e-test"), logs
+    # worker-0's success completes the job (no chief -> default success
+    # policy) and CleanPodPolicy Running then reaps still-running siblings,
+    # so under load fewer than 4 worker logs may survive — only the
+    # trained result is guaranteed, not the sibling count.
     worker_logs = client.get_logs(
         "dist-mnist-for-e2e-test", replica_type="worker")
-    assert len(worker_logs) == 4
+    assert worker_logs, "no worker logs survived"
     assert any("final loss" in t for t in worker_logs.values()), worker_logs
 
 
