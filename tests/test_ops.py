@@ -362,3 +362,36 @@ class TestAutotune:
         monkeypatch.setenv("TPUJOB_FLASH_BLOCK_K", "256")
         assert default_blocks(None, None) == (512, 256)
         assert default_blocks(64, 64) == (64, 64)  # explicit args win
+
+
+@pytest.mark.parametrize("bq,bk", [(256, 128), (128, 256), (256, 256),
+                                   (512, 128), (512, 512)])
+def test_flash_autotune_candidate_blocks_interpret(bq, bk):
+    """Every block shape the autotuner may pick (ops/autotune.py
+    DEFAULT_CANDIDATES) computes correct fwd+bwd in interpret mode —
+    on-chip tuning must only be a performance search, never a correctness
+    gamble.  t=512 exercises blocks up to full-sequence, including the
+    t-not-multiple interplay via the 512/256 mix."""
+    t, h, kv_h = 512, 2, 1
+    q, _, _ = qkv(t, d=32, b=1, h=h, seed=21)
+    keys = jax.random.split(jax.random.PRNGKey(22), 2)
+    k = jax.random.normal(keys[0], (1, kv_h, t, 32))
+    v = jax.random.normal(keys[1], (1, kv_h, t, 32))
+    g = jax.random.normal(jax.random.PRNGKey(23), q.shape)
+
+    out, dq, dk, dv = flash_attention_grads_interpret(
+        q, k, v, g, True, None, bq, bk)
+    kw, vw = (jnp.repeat(x, h // kv_h, axis=1) for x in (k, v))
+    ref, vjp = jax.vjp(
+        lambda q, k, v: xla_attention(q, k, v, causal=True), q, kw, vw)
+    dq_ref, dkw, dvw = vjp(g)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_ref), atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(dk),
+        np.asarray(dkw.reshape(1, kv_h, h // kv_h, t, 32).sum(axis=2)),
+        atol=1e-4)
+    np.testing.assert_allclose(
+        np.asarray(dv),
+        np.asarray(dvw.reshape(1, kv_h, h // kv_h, t, 32).sum(axis=2)),
+        atol=1e-4)
