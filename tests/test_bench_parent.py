@@ -90,14 +90,41 @@ def test_crashed_child_with_partial_steps_down(monkeypatch):
 
 
 def test_attention_timeout_marks_partial(monkeypatch):
+    monkeypatch.delenv("BENCH_SKIP_ATTENTION", raising=False)
     rows = _json({"fwd_bwd": [{"seq": 1024, "flash_ms": 1.0}],
                   "shape": {}, "kernel_path": "pallas"})
-    outcomes = [(-9, rows)]
-    run_script(monkeypatch, outcomes)
+    gqa_rows = _json({"fwd_bwd": [{"seq": 1024, "flash_ms": 1.2,
+                                   "kv_heads": 4}],
+                      "shape": {}, "kernel_path": "pallas"})
+    # main ladder times out mid-run; the gqa arm then completes
+    outcomes = [(-9, rows), (0, gqa_rows)]
+    calls = run_script(monkeypatch, outcomes)
     stages = []
     result = bench._attention_ladder("tpu", stages)
     assert result["partial_rc"] == -9
     assert "partial" in result
+    assert len(calls) == 2
+    assert result["gqa_arm"]["fwd_bwd"][0]["kv_heads"] == 4
+    assert [s["stage"] for s in stages] == ["attention", "attention:gqa"]
+
+
+def test_attention_gqa_arm_env(monkeypatch):
+    """The second child runs grouped-query shapes on shorter rungs."""
+    monkeypatch.delenv("BENCH_SKIP_ATTENTION", raising=False)
+    monkeypatch.delenv("BENCH_ATTN_GQA_SEQS", raising=False)
+    ok = _json({"fwd_bwd": [], "shape": {}, "kernel_path": "pallas"})
+    outcomes = [(0, ok), (0, ok)]
+    envs = []
+
+    def fake_run(cmd, env_extra, timeout):
+        envs.append(dict(env_extra))
+        return outcomes.pop(0) + ("",)
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    bench._attention_ladder("tpu", [])
+    assert "BENCH_ATTN_KV_H" not in envs[0]
+    assert envs[1]["BENCH_ATTN_KV_H"] == "4"
+    assert envs[1]["BENCH_ATTN_SEQS"] == "1024,4096"
 
 
 def test_cpu_fallback_single_rung(monkeypatch):

@@ -1,0 +1,70 @@
+"""Vision Transformer: patch embedding over the shared encoder stack.
+
+Widens the model zoo's vision coverage beyond ResNet (the reference's
+distribution_strategy examples are CNN-only; an attention-based vision
+model exercises the same Block/flash/tp machinery as the LMs on image
+workloads).  Architecture per Dosovitskiy et al. (arXiv:2010.11929):
+conv patchify -> prepend CLS -> learned positions -> pre-norm encoder
+Blocks (models/transformer.py — flash attention, tp rules, MoE, remat all
+compose for free) -> LayerNorm -> CLS head.
+"""
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .transformer import Block, TransformerConfig, _norm
+
+
+class ViT(nn.Module):
+    """cfg.max_len must cover num_patches + 1 (CLS); cfg.causal False."""
+
+    cfg: TransformerConfig
+    num_classes: int = 1000
+    patch_size: int = 16
+
+    @nn.compact
+    def __call__(self, images):
+        cfg = self.cfg
+        if cfg.causal:
+            raise ValueError(
+                "ViT needs causal=False (a causal mask over raster-order "
+                "patches silently degrades the model); use vit_base_config")
+        b, height, width, _c = images.shape
+        p = self.patch_size
+        if height % p or width % p:
+            raise ValueError(
+                f"image {height}x{width} not divisible by patch size {p}")
+        num_patches = (height // p) * (width // p)
+        if num_patches + 1 > cfg.max_len:
+            raise ValueError(
+                f"{num_patches} patches + CLS exceed max_len {cfg.max_len}")
+
+        x = nn.Conv(cfg.d_model, kernel_size=(p, p), strides=(p, p),
+                    dtype=cfg.dtype, name="patch_embed")(images)
+        x = x.reshape(b, num_patches, cfg.d_model)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, cfg.d_model))
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, cfg.d_model)).astype(x.dtype), x],
+            axis=1)
+        pos = self.param("pos_emb", nn.initializers.normal(0.02),
+                         (num_patches + 1, cfg.d_model))
+        x = (x + pos[None].astype(x.dtype)).astype(cfg.dtype)
+        for i in range(cfg.num_layers):
+            x = Block(cfg, name=f"block_{i}")(x)
+        x = _norm(cfg, "ln_f")(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        name="head")(x[:, 0])
+
+
+def vit_base_config(**overrides) -> TransformerConfig:
+    """ViT-B/16 shape: 12 layers, 12 heads, d=768, ff=3072; 224x224/16
+    -> 196 patches + CLS."""
+    base = dict(
+        vocab_size=1,  # unused (no token embedding)
+        num_layers=12, num_heads=12, d_model=768, d_ff=3072,
+        max_len=256, causal=False,
+    )
+    base.update(overrides)
+    return TransformerConfig(**base)
