@@ -575,3 +575,29 @@ def test_gang_churn_fuzz_over_k8s(k8s, seed):
         fuzz.check_safety()
     finally:
         fuzz.close()
+
+
+def test_gang_metrics_count_real_bindings(k8s, gang_sched):
+    """admitted_gangs/bound_gang_pods meter actual admissions and NEWLY
+    bound pods — retry sweeps over already-bound or unbindable pods must
+    not inflate the counter."""
+    from tf_operator_tpu.utils import metrics
+
+    server, cluster = k8s
+    admitted0 = metrics.admitted_gangs.labels().get()
+    bound0 = metrics.bound_gang_pods.labels().get()
+
+    server.add_node("m-node", allocatable={constants.TPU_RESOURCE: "8"})
+    gang_sched(retry_interval=0.2)
+    cluster.create_podgroup(PodGroup(
+        metadata=ObjectMeta(name="gm", namespace="default"), min_member=2))
+    cluster.create_pod(_gang_pod("gm-worker-0", "gm", 0, tpu=4.0))
+    cluster.create_pod(_gang_pod("gm-worker-1", "gm", 1, tpu=4.0))
+    assert _wait(lambda: _node_of(server, "gm-worker-0")
+                 and _node_of(server, "gm-worker-1"))
+    assert metrics.admitted_gangs.labels().get() == admitted0 + 1
+    assert metrics.bound_gang_pods.labels().get() == bound0 + 2
+    # several retry sweeps later the counters are unchanged (no re-count)
+    time.sleep(0.8)
+    assert metrics.admitted_gangs.labels().get() == admitted0 + 1
+    assert metrics.bound_gang_pods.labels().get() == bound0 + 2

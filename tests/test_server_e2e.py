@@ -118,3 +118,35 @@ spec:
 
     result = run_cli(base, "delete", "smoke-e2e")
     assert result.returncode == 0
+
+
+class TestGangFlagValidation:
+    """Misconfigurations are rejected at startup, not silently unenforced
+    (the caps/inventory only bind when the in-process scheduler runs)."""
+
+    def _run(self, argv):
+        from tf_operator_tpu.server.server import run
+
+        with pytest.raises(SystemExit) as exc:
+            run(argv)
+        return str(exc.value)
+
+    def test_slice_inventory_needs_podgroup(self):
+        msg = self._run(["--runtime", "memory", "--enable-gang-scheduling",
+                         "--gang-mechanism", "volcano",
+                         "--slice-inventory", "v5litepod-32:4x8:2"])
+        assert "--slice-inventory" in msg and "podgroup" in msg
+
+    def test_slice_chips_needs_podgroup(self):
+        msg = self._run(["--runtime", "memory", "--enable-gang-scheduling",
+                         "--gang-mechanism", "pdb", "--slice-chips", "32"])
+        assert "--slice-chips" in msg and "podgroup" in msg
+
+    def test_slice_chips_needs_gang_enabled(self):
+        msg = self._run(["--runtime", "memory", "--slice-chips", "32"])
+        assert "--slice-chips" in msg
+
+    def test_bad_inventory_entry_rejected(self):
+        msg = self._run(["--runtime", "memory", "--enable-gang-scheduling",
+                         "--slice-inventory", "nonsense"])
+        assert "--slice-inventory" in msg

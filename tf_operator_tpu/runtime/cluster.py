@@ -232,15 +232,18 @@ class InMemoryCluster(ClusterInterface):
             and pod.metadata.annotations.get(constants.GANG_GROUP_ANNOTATION)
         )
 
-    def bind_pod(self, namespace: str, name: str) -> None:
-        """Admit a gang-held pod: mark bound and start it."""
+    def bind_pod(self, namespace: str, name: str) -> int:
+        """Admit a gang-held pod: mark bound and start it.  Returns the
+        number of pods newly bound (0 if it was already bound) so callers
+        can meter real bindings, not attempts."""
         with self._lock:
             pod = self.get_pod(namespace, name)
             if pod.metadata.annotations.get(constants.ANNOTATION_BOUND) == "true":
-                return
+                return 0
             pod.metadata.annotations[constants.ANNOTATION_BOUND] = "true"
         self._started_pod(pod)
         self._dispatch(self._pod_handlers, EventType.MODIFIED, pod)
+        return 1
 
     def _started_pod(self, pod: Pod) -> None:
         """Hook for subclasses that actually run pods (LocalProcessCluster)."""

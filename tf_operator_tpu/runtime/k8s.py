@@ -819,11 +819,11 @@ class KubernetesCluster(ClusterInterface):
             total += quantity_to_float(limits.get(constants.TPU_RESOURCE, 0))
         return total
 
-    def bind_pod(self, namespace: str, name: str) -> None:
+    def bind_pod(self, namespace: str, name: str) -> int:
         """Schedule one admitted gang pod (see bind_pods)."""
-        self.bind_pods([(namespace, name)])
+        return self.bind_pods([(namespace, name)])
 
-    def bind_pods(self, targets: List[Tuple[str, str]]) -> None:
+    def bind_pods(self, targets: List[Tuple[str, str]]) -> int:
         """Schedule admitted gang pods: pick a feasible node per pod and POST
         the pods/binding subresource.  Feasibility = the pod's nodeSelector
         is a subset of the node's labels, and the node's allocatable TPU
@@ -832,9 +832,10 @@ class KubernetesCluster(ClusterInterface):
         nodes LIST + one pods LIST for the whole gang, not per member.  A
         pod with no feasible node stays Pending with a FailedScheduling
         event; the gang scheduler's periodic retry picks it up once nodes
-        change (node churn produces no pod watch events)."""
+        change (node churn produces no pod watch events).  Returns the
+        number of bindings actually posted."""
         if not targets:
-            return
+            return 0
         nodes = self.list_nodes()
         used: Dict[str, float] = {}
         wanted = set(targets)
@@ -912,7 +913,7 @@ class KubernetesCluster(ClusterInterface):
                              f"{requested:g} {constants.TPU_RESOURCE} "
                              "available; holding the whole gang unbound"),
                 ))
-            return
+            return 0
 
         # Phase 2 — post the bindings.
         for namespace, name, target in plan:
@@ -928,6 +929,7 @@ class KubernetesCluster(ClusterInterface):
             uid = ((raw_pods.get((namespace, name)) or {})
                    .get("metadata") or {}).get("uid", "")
             self._sched_warned.discard((namespace, name, uid))
+        return len(plan)
 
     # -- services --
 

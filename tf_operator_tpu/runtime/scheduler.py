@@ -31,6 +31,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from ..api import constants
 from ..api.core import Event, Pod
 from ..utils import logging as tpulog
+from ..utils import metrics
 from .cluster import ClusterInterface, EventType, NotFound
 from .slices import (
     Slice,
@@ -265,6 +266,7 @@ class GangScheduler:
         self._apply_slice_assignment(assignment)
         self._set_podgroup_phase(podgroup, "Running")
         log.info("admitting gang %s (%d pods, %.0f chips)", key, len(pods), chips)
+        metrics.admitted_gangs.labels().inc()
         self._bind_all(unbound)
 
     # ------------------------------------------------------------------
@@ -556,8 +558,10 @@ class GangScheduler:
             batch = getattr(self.cluster, "bind_pods", None)
             if batch is not None:
                 try:
-                    batch([(p.metadata.namespace, p.metadata.name)
-                           for p in pods])
+                    bound = batch([(p.metadata.namespace, p.metadata.name)
+                                   for p in pods])
+                    if bound:
+                        metrics.bound_gang_pods.labels().inc(int(bound))
                     return
                 except Exception as exc:  # noqa: BLE001 — fall back to singles
                     log.warning("batch bind failed (%r); retrying individually",
@@ -570,7 +574,11 @@ class GangScheduler:
         if binder is None:
             return
         try:
-            binder(pod.metadata.namespace, pod.metadata.name)
+            bound = binder(pod.metadata.namespace, pod.metadata.name)
+            if bound:
+                # bind_pod reports NEWLY bound pods (0/None for no-ops), so
+                # retry sweeps don't re-count the same pod
+                metrics.bound_gang_pods.labels().inc(int(bound))
         except NotFound:
             pass  # deleted between admission snapshot and bind
         except Exception as exc:  # noqa: BLE001 — isolate member failures
@@ -590,5 +598,8 @@ class GangScheduler:
             if self._is_bound(pod):
                 continue
             namespaces[key] = pod.metadata.namespace
+        with self._lock:
+            waiting = sum(1 for key in namespaces if key not in self._admitted)
+        metrics.waiting_gangs.labels().set(waiting)
         for key, namespace in namespaces.items():
             self._try_admit(key, namespace)

@@ -147,3 +147,18 @@ deleted_pdbs = REGISTRY.counter(
 is_leader = REGISTRY.gauge(
     "tpu_operator_is_leader", "Whether this operator instance is the leader"
 )
+# Gang-admission observability (no reference analogue — Volcano owns these
+# numbers there; here the in-process scheduler is the gang scheduler).
+admitted_gangs = REGISTRY.counter(
+    "tpu_operator_admitted_gangs_total",
+    "Counts gangs admitted (all-or-nothing) by the in-process scheduler",
+)
+bound_gang_pods = REGISTRY.counter(
+    "tpu_operator_bound_gang_pods_total",
+    "Counts gang pods NEWLY bound (virtually or via pods/binding); "
+    "no-op rebinds and retry attempts are not counted",
+)
+waiting_gangs = REGISTRY.gauge(
+    "tpu_operator_waiting_gangs",
+    "Gangs currently waiting for capacity or slice shapes",
+)

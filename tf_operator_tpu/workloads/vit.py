@@ -1,0 +1,105 @@
+"""Vision Transformer training workload.
+
+Attention-based vision training over the same sync-DP machinery as the
+ResNet workload (batch sharded over the controller-assigned mesh, XLA
+emits the gradient allreduce over ICI); tp composes via the shared Block
+rules for model-parallel ViT variants.
+
+Usage: python -m tf_operator_tpu.workloads.vit --steps 100 --batch 256
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch", type=int, default=256)
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--patch-size", type=int, default=16)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--layers", type=int, default=12)
+    parser.add_argument("--d-model", type=int, default=768)
+    parser.add_argument("--lr", type=float, default=3e-4)
+    parser.add_argument("--log-every", type=int, default=10)
+    from .runner import (
+        ProfileCapture, WorkloadContext, add_profile_args,
+        apply_forced_platform,
+    )
+
+    add_profile_args(parser)
+    args = parser.parse_args(argv)
+
+    apply_forced_platform()
+
+    ctx = WorkloadContext.from_env()
+    print(f"vit workload: role={ctx.replica_type} index={ctx.replica_index}",
+          flush=True)
+    ctx.initialize_distributed()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ..models.vit import ViT, vit_base_config
+    from ..train.state import create_train_state
+    from ..train.step import (
+        classification_loss_fn,
+        make_train_step,
+        shard_batch,
+        shard_train_state,
+    )
+
+    if args.image_size % args.patch_size:
+        print(f"--image-size {args.image_size} must divide by --patch-size "
+              f"{args.patch_size}", flush=True)
+        return 2
+    patches = (args.image_size // args.patch_size) ** 2
+    heads = max(1, args.d_model // 64)
+    mesh = ctx.build_mesh()
+    cfg = vit_base_config(
+        num_layers=args.layers, num_heads=heads, d_model=args.d_model,
+        d_ff=4 * args.d_model, max_len=patches + 1, mesh=mesh,
+    )
+    model = ViT(cfg, num_classes=args.num_classes,
+                patch_size=args.patch_size)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, optax.adamw(args.lr),
+        jnp.zeros((2, args.image_size, args.image_size, 3), jnp.bfloat16),
+    )
+    state = shard_train_state(state, mesh)
+    step = make_train_step(classification_loss_fn(model.apply))
+
+    rng = np.random.RandomState(ctx.replica_index)
+
+    def batch():
+        return {
+            "x": rng.randn(args.batch, args.image_size, args.image_size,
+                           3).astype(np.float32),
+            "label": rng.randint(0, args.num_classes,
+                                 args.batch).astype(np.int32),
+        }
+
+    prof = ProfileCapture(args.profile_dir, args.profile_start,
+                          args.profile_steps)
+    t0 = time.time()
+    loss = float("nan")
+    for i in range(args.steps):
+        prof.step(i)
+        state, metrics = step(state, shard_batch(batch(), mesh))
+        loss = float(metrics["loss"])
+        if i % args.log_every == 0:
+            print(f"step {i} loss {loss:.4f}", flush=True)
+    prof.close()
+    dt = time.time() - t0
+    print(f"final loss {loss:.4f} ({args.steps * args.batch / dt:.1f} "
+          "images/sec)", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
