@@ -15,23 +15,38 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.pipeline import gpipe, one_f_one_b
+from ..parallel.pipeline import gpipe, gpipe_interleaved, one_f_one_b
 from .transformer import Block, TransformerConfig
 
 
 class PipelinedTransformerLM:
     def __init__(self, cfg: TransformerConfig, mesh: Mesh,
-                 num_microbatches: int = 4, pp_axis: str = "pp") -> None:
+                 num_microbatches: int = 4, pp_axis: str = "pp",
+                 virtual_stages: int = 1) -> None:
         self.cfg = cfg
         self.mesh = mesh
         self.num_microbatches = num_microbatches
         self.pp_axis = pp_axis
         self.num_stages = mesh.shape[pp_axis]
-        if cfg.num_layers % self.num_stages:
+        # virtual_stages > 1 selects the interleaved schedule: each rank
+        # holds V chunks (chunk g = v*P + r) and the forward traverses the
+        # ring V times with 1/V-cost steps, shrinking the pipeline bubble
+        # ~V-fold (parallel/pipeline.gpipe_interleaved; needs
+        # num_microbatches <= stages).
+        self.virtual_stages = virtual_stages
+        chunks = self.num_stages * virtual_stages
+        if cfg.num_layers % chunks:
             raise ValueError(
-                f"num_layers {cfg.num_layers} must divide by pipeline stages {self.num_stages}"
+                f"num_layers {cfg.num_layers} must divide by stages x "
+                f"virtual_stages = {chunks}"
             )
-        self.layers_per_stage = cfg.num_layers // self.num_stages
+        if virtual_stages > 1 and num_microbatches > self.num_stages:
+            # fail at construction, not at the first traced loss call
+            raise ValueError(
+                f"interleaved schedule needs num_microbatches "
+                f"({num_microbatches}) <= pipeline stages "
+                f"({self.num_stages}); see gpipe_interleaved")
+        self.layers_per_stage = cfg.num_layers // chunks
         self._block = Block(cfg)
 
     # ------------------------------------------------------------------
@@ -43,10 +58,18 @@ class PipelinedTransformerLM:
         layer_params = [
             self._block.init(keys[i], dummy)["params"] for i in range(cfg.num_layers)
         ]
-        # [stages, layers_per_stage, ...] leaves
+        # [stages, layers_per_stage, ...] leaves — or, interleaved,
+        # [stages, virtual, layers_per_chunk, ...] with chunk g = v*P + r
+        # holding global layers [g*lpc, (g+1)*lpc): stack chunk-major
+        # [V*P, lpc, ...], view as [V, P, ...], then put the rank dim first.
         def stack(*leaves):
             flat = jnp.stack(leaves)
-            return flat.reshape(self.num_stages, self.layers_per_stage, *flat.shape[1:])
+            if self.virtual_stages == 1:
+                return flat.reshape(
+                    self.num_stages, self.layers_per_stage, *flat.shape[1:])
+            return flat.reshape(
+                self.virtual_stages, self.num_stages, self.layers_per_stage,
+                *flat.shape[1:]).swapaxes(0, 1)
 
         stages = jax.tree_util.tree_map(stack, *layer_params)
         params = {
@@ -125,10 +148,16 @@ class PipelinedTransformerLM:
 
     def apply(self, params, tokens: jax.Array) -> jax.Array:
         x = self._embed(params, tokens)
-        x = gpipe(
-            self._stage_fn, params["stages"], x, self.mesh,
-            self.num_microbatches, axis=self.pp_axis,
-        )
+        if self.virtual_stages > 1:
+            x = gpipe_interleaved(
+                self._stage_fn, params["stages"], x, self.mesh,
+                self.num_microbatches, axis=self.pp_axis,
+            )
+        else:
+            x = gpipe(
+                self._stage_fn, params["stages"], x, self.mesh,
+                self.num_microbatches, axis=self.pp_axis,
+            )
         return self._head_logits(params, x)
 
     # ------------------------------------------------------------------
@@ -149,6 +178,11 @@ class PipelinedTransformerLM:
         """Next-token loss through the fused 1F1B schedule (O(P) live
         microbatch residuals; see parallel/pipeline.one_f_one_b).  Same
         math as loss_gpipe — the schedules must agree to float tolerance."""
+        if self.virtual_stages > 1:
+            raise ValueError(
+                "the fused 1F1B loop does not implement virtual stages; "
+                "use loss_gpipe with virtual_stages > 1 (interleaved "
+                "forward, autodiff backward)")
         x = self._embed(params, tokens)
         head = {
             k: params[k]

@@ -26,6 +26,22 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .ring_attention import shard_map
 
 
+def _stage_param_specs(stacked_params, axis: str):
+    """P(axis) on the leading (stage) dim of every stage-stacked leaf —
+    the one sharding contract all three schedules share."""
+    return jax.tree_util.tree_map(
+        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params
+    )
+
+
+def _split_microbatches(x: jax.Array, num_microbatches: int) -> jax.Array:
+    batch = x.shape[0]
+    if batch % num_microbatches:
+        raise ValueError(
+            f"batch {batch} not divisible by microbatches {num_microbatches}")
+    return x.reshape(num_microbatches, batch // num_microbatches, *x.shape[1:])
+
+
 def gpipe(
     stage_fn: Callable,
     stacked_params,
@@ -42,9 +58,7 @@ def gpipe(
     """
     num_stages = mesh.shape[axis]
     batch = x.shape[0]
-    if batch % num_microbatches:
-        raise ValueError(f"batch {batch} not divisible by microbatches {num_microbatches}")
-    x_mb = x.reshape(num_microbatches, batch // num_microbatches, *x.shape[1:])
+    x_mb = _split_microbatches(x, num_microbatches)
 
     def local(params, x_mb):
         rank = lax.axis_index(axis)
@@ -74,9 +88,101 @@ def gpipe(
         _, out = lax.fori_loop(0, num_mb + num_stages - 1, step, (carry_in, out))
         return lax.psum(out, axis)
 
-    param_specs = jax.tree_util.tree_map(
-        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params
+    param_specs = _stage_param_specs(stacked_params, axis)
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+        check_rep=False,
     )
+    out_mb = fn(stacked_params, x_mb)
+    return out_mb.reshape(batch, *x.shape[1:])
+
+
+def gpipe_interleaved(
+    stage_fn: Callable,
+    stacked_params,
+    x: jax.Array,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis: str = "pp",
+) -> jax.Array:
+    """Interleaved (virtual-stage) pipeline forward: each rank holds V model
+    CHUNKS instead of one contiguous stage (chunk g = v·P + r lives on rank
+    r as its v-th slice), so activations traverse the ring V times and each
+    pipeline step costs 1/V of a full stage.  Total steps V·P + M − 1 at
+    1/V stage-cost each ≈ (P + (M−1)/V)·T_stage wall-clock vs GPipe's
+    (M + P − 1)·T_stage — the warmup/cooldown bubble shrinks by ~V (the
+    Megatron-LM interleaved-schedule idea, arXiv:2104.04473 §2.2).
+
+    stacked_params: leaves [P, V, ...] (P sharded over `axis`); stage_fn
+    receives one chunk's [...] slice and must be shape-preserving.
+
+    Schedule invariant: work item (microbatch m, chunk-phase v) runs on
+    rank r at step s = v·P + r + m.  Requiring M <= P makes the item per
+    (rank, step) UNIQUE (two candidates would need microbatch indices P
+    apart), so every rank runs one chunk per step with the same single
+    ppermute ring as gpipe — rank P−1's chunk-v output arrives at rank 0
+    exactly when it becomes that microbatch's chunk-(v+1) input.  For
+    M > P use gpipe (or raise V so M = P covers the batch).
+    """
+    num_stages = mesh.shape[axis]
+    virtual = jax.tree_util.tree_leaves(stacked_params)[0].shape[1]
+    batch = x.shape[0]
+    if num_microbatches > num_stages:
+        raise ValueError(
+            f"interleaved schedule needs microbatches ({num_microbatches}) "
+            f"<= pipeline stages ({num_stages}); the conflict-free step "
+            "assignment (item uniqueness per rank per step) depends on it — "
+            "use gpipe for deeper microbatching")
+    x_mb = _split_microbatches(x, num_microbatches)
+
+    def local(params, x_mb):
+        rank = lax.axis_index(axis)
+        num_mb = x_mb.shape[0]
+        # [1, V, ...] -> [V, ...] per-rank chunk stack
+        chunks = jax.tree_util.tree_map(lambda p: p[0], params)
+        out = jnp.zeros_like(x_mb)
+        carry = jnp.zeros_like(x_mb[0])
+        perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+
+        def step(s, state):
+            carry, out = state
+            q = s - rank
+            v = jnp.floor_divide(q, num_stages)
+            m = q - v * num_stages  # in [0, P) when q >= 0
+            valid = jnp.logical_and(
+                jnp.logical_and(v >= 0, v < virtual), m < num_mb)
+            m_idx = jnp.clip(m, 0, num_mb - 1)
+            v_idx = jnp.clip(v, 0, virtual - 1)
+            feed = lax.dynamic_index_in_dim(x_mb, m_idx, 0, keepdims=False)
+            # chunk-0 inputs at rank 0 come from the data; every other
+            # (rank, chunk) consumes the ring carry — including rank 0's
+            # chunk v>0, which is rank P-1's chunk v-1 output (same m, by
+            # the schedule invariant)
+            inp = jnp.where(jnp.logical_and(rank == 0, v_idx == 0),
+                            feed, carry)
+            chunk = jax.tree_util.tree_map(
+                lambda c: lax.dynamic_index_in_dim(c, v_idx, 0,
+                                                   keepdims=False),
+                chunks)
+            act = stage_fn(chunk, inp)
+            act = jnp.where(valid, act, jnp.zeros_like(act))
+            is_writer = jnp.logical_and(
+                valid,
+                jnp.logical_and(rank == num_stages - 1,
+                                v_idx == virtual - 1))
+            cur = lax.dynamic_index_in_dim(out, m_idx, 0, keepdims=False)
+            out = lax.dynamic_update_index_in_dim(
+                out, jnp.where(is_writer, act, cur), m_idx, 0)
+            return lax.ppermute(act, axis, perm), out
+
+        _, out = lax.fori_loop(
+            0, virtual * num_stages + num_mb - 1, step, (carry, out))
+        return lax.psum(out, axis)
+
+    param_specs = _stage_param_specs(stacked_params, axis)
     fn = shard_map(
         local,
         mesh=mesh,
@@ -230,9 +336,7 @@ def one_f_one_b(
         dstages = jax.tree_util.tree_map(lambda t: t[None], dstages)
         return loss, dstages, dhead, dx
 
-    param_specs = jax.tree_util.tree_map(
-        lambda p: P(axis, *([None] * (p.ndim - 1))), stacked_params
-    )
+    param_specs = _stage_param_specs(stacked_params, axis)
     head_specs = jax.tree_util.tree_map(lambda p: P(), head_params)
     fused = shard_map(
         _fused,
