@@ -229,6 +229,10 @@ def _attention_ladder(platform, stages):
     def run_child(tag, extra_env, timeout=CHILD_TIMEOUT):
         env = {} if platform is not None else dict(
             TPUJOB_FORCE_PLATFORM="cpu", BENCH_ATTN_SEQS="256,512")
+        # persist autotune results across bench attempts — a flaky-window
+        # rerun must not redo a completed block-shape search
+        env.setdefault("TPUJOB_AUTOTUNE_CACHE",
+                       os.path.join(REPO, "artifacts", "autotune_cache.json"))
         env.update(extra_env)
         t0 = time.time()
         rc, out, err = _run(
