@@ -296,3 +296,39 @@ def test_cr_update_requires_resource_version(strict):
             "/apis/tpu-operator.dev/v1/namespaces/default/tpujobs/rv-job",
             body=body)
     assert "must be specified" in str(err.value)
+
+
+def test_elastic_scale_over_the_wire(k8s):
+    """EnableDynamicWorker scale up/down through real apiserver updates:
+    replica edits arrive via update_job (read-inject-PUT on the strict
+    fixture), the reconciler creates/deletes indexed pods server-side."""
+    from tf_operator_tpu.api.types import ReplicaType
+
+    server, cluster = k8s
+    controller = TPUJobController(cluster)
+    job = new_tpujob(worker=2, name="conf-elastic")
+    job.spec.enable_dynamic_worker = True
+    job.metadata.uid = ""
+    cluster.create_job(job)
+    controller.sync_job("default/conf-elastic")
+    assert sorted(server.objects("pods")) == [
+        "conf-elastic-worker-0", "conf-elastic-worker-1"]
+
+    got = cluster.get_job("default", "conf-elastic")
+    got.spec.replica_specs[ReplicaType.WORKER].replicas = 3
+    cluster.update_job(got)
+    controller.sync_job("default/conf-elastic")
+    pods = server.objects("pods")
+    assert sorted(pods) == [
+        "conf-elastic-worker-0", "conf-elastic-worker-1",
+        "conf-elastic-worker-2"]
+    env = {e["name"]: e["value"]
+           for e in pods["conf-elastic-worker-2"]["spec"]["containers"][0]["env"]}
+    assert "TF_CONFIG" in env and '"index": 2' in env["TF_CONFIG"].replace(
+        '"index":2', '"index": 2')
+
+    got = cluster.get_job("default", "conf-elastic")
+    got.spec.replica_specs[ReplicaType.WORKER].replicas = 1
+    cluster.update_job(got)
+    controller.sync_job("default/conf-elastic")
+    assert sorted(server.objects("pods")) == ["conf-elastic-worker-0"]
