@@ -8,7 +8,8 @@ jax` itself, so the probe must be a killable child), and when it is live,
 burn down the pending hardware-evidence list in priority order:
 
   1. full bench with the LM model first (LM tokens/sec + MFU, then the
-     second model, then the flash-vs-XLA attention ladder) -> bench JSON
+     flash-vs-XLA attention ladder, then the second model — the two
+     gating artifacts before corroboration) -> bench JSON
   2. GQA compiled kernel tests (`pytest -m tpu -k gqa`)
   3. the full TPU test tier (`pytest -m tpu`)
 
@@ -93,7 +94,8 @@ def bench_complete(path: str) -> bool:
 def do_bench() -> bool:
     log("stage bench: starting (BENCH_MODEL=lm first)")
     rc, out, _err = run([sys.executable, "bench.py"], timeout=3900,
-                        env={"BENCH_MODEL": "lm"})
+                        env={"BENCH_MODEL": "lm",
+                             "BENCH_ATTENTION_FIRST": "1"})
     lines = [ln for ln in out.strip().splitlines() if ln.strip()]
     if not lines:
         log(f"stage bench: no output (rc={rc})")
