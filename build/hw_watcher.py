@@ -7,11 +7,14 @@ loops: probe the backend in a subprocess (a wedged tunnel hangs `import
 jax` itself, so the probe must be a killable child), and when it is live,
 burn down the pending hardware-evidence list in priority order:
 
-  1. full bench with the LM model first (LM tokens/sec + MFU, then the
-     flash-vs-XLA attention ladder, then the second model — the two
-     gating artifacts before corroboration) -> bench JSON
-  2. GQA compiled kernel tests (`pytest -m tpu -k gqa`)
-  3. the full TPU test tier (`pytest -m tpu`)
+  1. the micro probes (build/micro_tpu_probe.py, micro_gqa_probe.py,
+     micro_lm_probe.py) — each sized for a ~1-2 minute window; together
+     they cover flash-vs-XLA perf, compiled-GQA numerics+perf, and LM
+     tokens/sec+MFU on chip even if no window ever fits the bench
+  2. full bench with the LM model first (LM tokens/sec + MFU, then the
+     flash-vs-XLA attention ladder, then the second model) -> bench JSON
+  3. GQA compiled kernel tests (`pytest -m tpu -k gqa`)
+  4. the full TPU test tier (`pytest -m tpu`, in two budgeted chunks)
 
 Every capture goes to a temp file first and only replaces the artifact
 when the capture is non-empty and (for the bench) parses as JSON — a
@@ -46,6 +49,11 @@ TIER = os.path.join(ART, f"tpu_tier_{STAMP}.log")
 TIER_OPS = os.path.join(ART, f"tpu_tier_ops_{STAMP}.log")
 TIER_REST = os.path.join(ART, f"tpu_tier_rest_{STAMP}.log")
 MICRO = os.path.join(ART, f"micro_flash_{STAMP}.json")
+# Window-sized companions to the flash micro (see build/micro_*_probe.py):
+# compiled-GQA numerics+timing and LM tokens/sec+MFU — together they cover
+# the verdict's three on-chip asks even if no window ever fits the bench.
+MICRO_GQA = os.path.join(ART, f"micro_gqa_{STAMP}.json")
+MICRO_LM = os.path.join(ART, f"micro_lm_{STAMP}.json")
 
 
 def log(msg: str) -> None:
@@ -168,22 +176,21 @@ def do_pytest(expr, timeout, dest, label, paths=("tests/",), extra=()) -> bool:
     return False
 
 
-def do_micro() -> bool:
-    """The ~1-minute-window stage: compiled flash-vs-XLA at one seq length,
-    emitted incrementally by build/micro_tpu_probe.py (a window dying after
-    the flash arm still leaves kernel-path perf evidence on disk)."""
-    log("stage micro: starting")
-    rc, out, err = run([sys.executable, "build/micro_tpu_probe.py", MICRO],
-                       timeout=420)
-    done = micro_complete(MICRO)
+def do_micro(script: str, out_path: str, label: str) -> bool:
+    """A ~1-2 minute-window stage: one of the build/micro_*_probe.py
+    scripts, all of which emit their JSON incrementally (a window dying
+    mid-run still leaves the earlier arms on disk)."""
+    log(f"stage {label}: starting")
+    rc, out, err = run([sys.executable, script, out_path], timeout=420)
+    done = micro_complete(out_path)
     try:
-        with open(MICRO) as f:
-            log(f"stage micro: rc={rc} doc={json.load(f)}")
+        with open(out_path) as f:
+            log(f"stage {label}: rc={rc} doc={json.load(f)}")
     except (OSError, ValueError):
-        log(f"stage micro: no artifact (rc={rc}); err tail: {err[-200:]!r}")
-    if not done and os.path.exists(MICRO):
-        # keep a partial under another name; retry for the full pair
-        os.replace(MICRO, next_partial(MICRO))
+        log(f"stage {label}: no artifact (rc={rc}); err tail: {err[-200:]!r}")
+    if not done and os.path.exists(out_path):
+        # keep a partial under another name; retry for the full run
+        os.replace(out_path, next_partial(out_path))
     return done
 
 
@@ -215,15 +222,17 @@ def file_green(path: str) -> bool:
 
 def micro_complete(path: str) -> bool:
     """Single source of truth for micro-probe completeness, used both by
-    do_micro (retention) and stage_done (retirement): the probe writes
-    its JSON incrementally, so a mid-stage kill can leave an incomplete
-    doc at the final name."""
+    do_micro (retention) and stage_done (retirement): the probes write
+    their JSON incrementally, so a mid-stage kill can leave an incomplete
+    doc at the final name.  Every build/micro_*_probe.py emits
+    `total_sec` only in its final on-chip emit, so on_tpu + total_sec
+    means the run reached the end."""
     try:
         with open(path) as f:
             doc = json.load(f)
     except (OSError, ValueError):
         return False
-    return bool(doc.get("on_tpu")) and "speedup" in doc
+    return bool(doc.get("on_tpu")) and "total_sec" in doc
 
 
 def stage_done(p: str) -> bool:
@@ -238,7 +247,7 @@ def stage_done(p: str) -> bool:
                 or (file_green(TIER_OPS) and file_green(TIER_REST)))
     if p == GQA:
         return file_green(p)
-    if p == MICRO:
+    if p in (MICRO, MICRO_GQA, MICRO_LM):
         return micro_complete(p)
     return os.path.exists(p)
 
@@ -248,16 +257,23 @@ def main() -> None:
     start = time.time()
     log(f"watcher up, stamp={STAMP}, budget={MAX_SECONDS / 3600:.1f}h")
     while time.time() - start < MAX_SECONDS:
-        pending = [p for p in (MICRO, BENCH, GQA, TIER)
+        pending = [p for p in (MICRO, MICRO_GQA, MICRO_LM, BENCH, GQA, TIER)
                    if not stage_done(p)]
         if not pending:
             log("ALL_DONE: every artifact recorded")
             return
         if probe():
             log(f"tunnel LIVE; pending: {[os.path.basename(p) for p in pending]}")
-            # micro first: it fits in a window nothing else can use
+            # micros first: they fit in windows nothing else can use, and
+            # together (flash perf, GQA-compiled numerics+perf, LM
+            # tokens/sec+MFU) they cover the three on-chip asks even if
+            # no window ever fits the bench.
             if not stage_done(MICRO):
-                do_micro()
+                do_micro("build/micro_tpu_probe.py", MICRO, "micro")
+            if not stage_done(MICRO_GQA) and probe():
+                do_micro("build/micro_gqa_probe.py", MICRO_GQA, "micro-gqa")
+            if not stage_done(MICRO_LM) and probe():
+                do_micro("build/micro_lm_probe.py", MICRO_LM, "micro-lm")
             if not stage_done(BENCH) and probe():
                 do_bench()
             if not stage_done(GQA) and probe():

@@ -1,0 +1,139 @@
+"""On-chip GQA-kernel evidence sized for a short live window.
+
+The GQA-native flash path (grouped K/V heads mapped in-kernel, never
+repeated in HBM — ops/attention.py) is pinned in interpret mode by
+tests/test_ops.py, but interpret mode has already missed one Mosaic
+lowering bug (round 2), so the verdict wants the *compiled* path proven
+on silicon.  The full `pytest -m tpu -k gqa` tier needs a longer window
+than the tunnel usually grants; this probe captures the same evidence —
+compiled fwd+grads numerics vs the widened f32 reference, plus wall time
+vs the repeat-K/V XLA path — in one ~2-minute incremental-emission run.
+
+Usage: python build/micro_gqa_probe.py [out.json]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "artifacts/micro_gqa.json"
+
+
+def emit(doc):
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, OUT)
+
+
+def main():
+    t0 = time.time()
+    from tf_operator_tpu.workloads.runner import apply_forced_platform
+
+    apply_forced_platform()
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.ops.attention import (
+        _on_tpu, flash_attention, xla_attention,
+    )
+
+    b, h, kv_h, t, d = 1, 8, 2, 1024, 64
+    doc = {
+        "platform": jax.devices()[0].platform,
+        "devices": len(jax.devices()),
+        "on_tpu": _on_tpu(),
+        "shape": {"b": b, "h": h, "kv_heads": kv_h, "t": t, "d": d},
+        "connect_sec": round(time.time() - t0, 1),
+    }
+    emit(doc)
+    if not doc["on_tpu"]:
+        doc["note"] = "not on TPU; compiled-kernel evidence needs the chip"
+        emit(doc)
+        print(json.dumps(doc))
+        return
+
+    group = h // kv_h
+    keys = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(keys[0], (b, h, t, d)).astype(jnp.bfloat16)
+    k = jax.random.normal(keys[1], (b, kv_h, t, d)).astype(jnp.bfloat16)
+    v = jax.random.normal(keys[2], (b, kv_h, t, d)).astype(jnp.bfloat16)
+
+    # Widened f32 reference: repeat K/V to full heads in HBM, XLA attention
+    # (same oracle as tests/test_ops.py::test_gqa_compiled).
+    def widened(q32, k32, v32):
+        return xla_attention(
+            q32, jnp.repeat(k32, group, axis=1),
+            jnp.repeat(v32, group, axis=1), causal=True)
+
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+
+    # --- compiled forward numerics (same tolerance shape as the oracle in
+    # tests/test_ops.py::test_gqa_compiled: atol + rtol * |ref|) ---
+    def close(x, r, atol, rtol):
+        return bool(jnp.all(jnp.abs(x - r) <= atol + rtol * jnp.abs(r)))
+
+    out = jax.jit(lambda q, k, v: flash_attention(q, k, v, True))(q, k, v)
+    ref = widened(qf, kf, vf)
+    fwd_err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref)))
+    doc.update(fwd_max_abs_err=round(fwd_err, 5),
+               fwd_ok=close(out.astype(jnp.float32), ref, 0.05, 0.05),
+               kernel_path="pallas")
+    emit(doc)
+
+    # --- compiled grads numerics ---
+    def loss(attn, *args):
+        return jnp.sum(attn(*args).astype(jnp.float32) ** 2)
+
+    grads = jax.jit(jax.grad(
+        lambda q, k, v: loss(lambda *a: flash_attention(*a, True), q, k, v),
+        argnums=(0, 1, 2)))(q, k, v)
+    refs = jax.jit(jax.grad(
+        lambda q, k, v: loss(widened, q, k, v), argnums=(0, 1, 2)))(qf, kf, vf)
+    rel_errs = {}
+    ok = True
+    for name, g, r in zip(("dq", "dk", "dv"), grads, refs):
+        denom = float(jnp.max(jnp.abs(r))) or 1.0
+        rel_errs[name] = round(
+            float(jnp.max(jnp.abs(g.astype(jnp.float32) - r))) / denom, 5)
+        # test_gqa_compiled's grad tolerance: atol=0.1, rtol=0.1
+        ok = ok and close(g.astype(jnp.float32), r, 0.1, 0.1)
+    doc.update(grad_max_rel_err=rel_errs, grads_ok=ok)
+    emit(doc)  # numerics safe on disk before the timing arms
+
+    # --- timing: GQA flash (in-kernel grouping) vs repeat-K/V XLA ---
+    def timed(fn, reps=3):
+        grad = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+        c0 = time.time()
+        outv = grad(q, k, v)
+        jax.device_get([jnp.sum(x.astype(jnp.float32)) for x in outv])
+        compile_sec = time.time() - c0
+        t1 = time.perf_counter()
+        for _ in range(reps):
+            outv = grad(q, k, v)
+        jax.device_get([jnp.sum(x.astype(jnp.float32)) for x in outv])
+        return (time.perf_counter() - t1) / reps * 1e3, compile_sec
+
+    flash_ms, flash_compile = timed(
+        lambda q, k, v: flash_attention(q, k, v, True))
+    doc.update(flash_ms=round(flash_ms, 3),
+               flash_compile_sec=round(flash_compile, 1))
+    emit(doc)
+
+    xla_ms, xla_compile = timed(
+        lambda q, k, v: xla_attention(
+            q, jnp.repeat(k, group, axis=1), jnp.repeat(v, group, axis=1),
+            causal=True))
+    doc.update(xla_ms=round(xla_ms, 3), xla_compile_sec=round(xla_compile, 1),
+               speedup=round(xla_ms / flash_ms, 3),
+               total_sec=round(time.time() - t0, 1))
+    emit(doc)
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,137 @@
+"""On-chip LM training evidence sized for a short live window.
+
+Companion to build/micro_tpu_probe.py (flash-vs-XLA micro): the tunneled
+TPU wedges for hours with occasional ~1-minute live windows, and the full
+bench's LM stage (compile + interleaved fw/bare windows) cannot finish in
+one.  This captures the next-highest-value data the verdict asks for — LM
+training tokens/sec and MFU on the real chip — in two escalating stages,
+each emitted incrementally so a window that dies mid-run keeps whatever
+landed:
+
+  1. "tiny"  — 2L/256d model, t=512, b=2: compiles fast; proves the
+     framework train step (flash kernel path included) executes on chip
+     and yields a first tokens/sec + MFU datum.
+  2. "base"  — the bench's default 12L/768d GPT config at t=1024, b=4:
+     the headline-comparable number (BENCH_r* uses the same shape family).
+
+MFU uses the same estimate as bench.py: flops/token ~= 6P + 6*L*d_model*T
+against the v5e bf16 peak (197 TFLOP/s/chip).
+
+Usage: python build/micro_lm_probe.py [out.json]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "artifacts/micro_lm.json"
+V5E_PEAK_FLOPS = 197e12  # bench.py's MFU denominator
+
+
+def emit(doc):
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, OUT)
+
+
+def run_stage(*, layers, d_model, heads, d_ff, vocab, seq, batch, steps=5):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from tf_operator_tpu.models.transformer import (
+        TransformerConfig, TransformerLM,
+    )
+    from tf_operator_tpu.train.state import create_train_state
+    from tf_operator_tpu.train.step import lm_loss_fn, make_train_step
+
+    t0 = time.time()
+    cfg = TransformerConfig(
+        vocab_size=vocab, num_layers=layers, num_heads=heads,
+        d_model=d_model, d_ff=d_ff, max_len=seq, causal=True,
+        dtype=jnp.bfloat16,
+    )
+    model = TransformerLM(cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randint(0, vocab, (batch, seq + 1)), jnp.int32)
+    batch_d = {"tokens": tokens}
+    tx = optax.sgd(0.1, momentum=0.9)
+    state = create_train_state(
+        jax.random.PRNGKey(0), model, tx, tokens[:2, :-1])
+    step = make_train_step(lm_loss_fn(model.apply))
+
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(state.params))
+    flops_per_token = 6 * n_params + 6 * layers * d_model * seq
+
+    c0 = time.time()
+    state, metrics = step(state, batch_d)
+    jax.block_until_ready(metrics["loss"])
+    compile_sec = time.time() - c0
+
+    t1 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch_d)
+    jax.block_until_ready(metrics["loss"])
+    step_sec = (time.perf_counter() - t1) / steps
+    tokens_per_sec = batch * seq / step_sec
+
+    return {
+        "config": {"layers": layers, "d_model": d_model, "heads": heads,
+                   "d_ff": d_ff, "vocab": vocab, "seq": seq, "batch": batch},
+        "n_params": n_params,
+        "compile_sec": round(compile_sec, 1),
+        "timed_steps": steps,
+        "step_ms": round(step_sec * 1e3, 2),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "mfu": round(tokens_per_sec * flops_per_token / V5E_PEAK_FLOPS, 6),
+        "loss": float(metrics["loss"]),
+        "stage_sec": round(time.time() - t0, 1),
+    }
+
+
+def main():
+    t0 = time.time()
+    from tf_operator_tpu.workloads.runner import apply_forced_platform
+
+    apply_forced_platform()
+    import jax
+
+    from tf_operator_tpu.ops.attention import _on_tpu
+
+    doc = {
+        "platform": jax.devices()[0].platform,
+        "devices": len(jax.devices()),
+        # _on_tpu is the framework's single source of truth for "the flash
+        # kernel path is live" (it accepts aliased backends the bare
+        # platform string comparison would miss).
+        "on_tpu": _on_tpu(),
+        "peak_flops": V5E_PEAK_FLOPS,
+        "connect_sec": round(time.time() - t0, 1),
+    }
+    emit(doc)
+    if not doc["on_tpu"]:
+        doc["note"] = "not on TPU; MFU vs v5e peak would be meaningless"
+        emit(doc)
+        print(json.dumps(doc))
+        return
+
+    doc["tiny"] = run_stage(
+        layers=2, d_model=256, heads=4, d_ff=1024,
+        vocab=8192, seq=512, batch=2)
+    emit(doc)  # first on-chip LM datum safe before the big compile
+
+    doc["base"] = run_stage(
+        layers=12, d_model=768, heads=12, d_ff=3072,
+        vocab=32000, seq=1024, batch=4)
+    doc["total_sec"] = round(time.time() - t0, 1)
+    emit(doc)
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()
