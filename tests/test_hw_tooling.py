@@ -1,0 +1,165 @@
+"""Capture-correctness invariants for the hardware-evidence tooling.
+
+build/hw_watcher.py decides when a TPU-evidence artifact is *complete* —
+the flaky tunneled backend means wedge-truncated captures are the common
+case, and an incomplete capture that retires a stage (or a complete one
+that fails to) silently loses scarce live-window evidence.  These tests
+pin the promotion/retirement criteria shared by the watcher and
+build/tpu_hw_check.sh (which imports them rather than re-implementing).
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def hw(tmp_path, monkeypatch):
+    """Import build/hw_watcher.py with its artifact paths redirected into
+    tmp_path.  The module resolves STAMP from sys.argv at import time, so
+    pin argv before exec."""
+    monkeypatch.setattr(sys, "argv", ["hw_watcher.py", "tst"])
+    spec = importlib.util.spec_from_file_location(
+        "hw_watcher_under_test", str(REPO / "build" / "hw_watcher.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.ART = str(tmp_path)
+    for name, fname in (
+        ("BENCH", "bench_tst.json"),
+        ("GQA", "gqa_tpu_tst.log"),
+        ("TIER", "tpu_tier_tst.log"),
+        ("TIER_OPS", "tpu_tier_ops_tst.log"),
+        ("TIER_REST", "tpu_tier_rest_tst.log"),
+        ("MICRO", "micro_flash_tst.json"),
+        ("MICRO_GQA", "micro_gqa_tst.json"),
+        ("MICRO_LM", "micro_lm_tst.json"),
+    ):
+        setattr(mod, name, str(tmp_path / fname))
+    return mod
+
+
+class TestTailGreen:
+    def test_green_summary(self, hw):
+        assert hw.tail_green("13 passed in 45.9s")
+
+    def test_failures_not_green(self, hw):
+        assert not hw.tail_green("2 failed, 11 passed in 840s")
+
+    def test_errors_not_green(self, hw):
+        assert not hw.tail_green("3 passed\n1 error in 5s")
+
+    def test_xfail_is_green(self, hw):
+        assert hw.tail_green("1 xfailed, 5 passed in 2s")
+
+    def test_warning_text_mentioning_error_class_is_green(self, hw):
+        assert hw.tail_green(
+            "DeprecationError class will change\n5 passed in 2s")
+
+    def test_truncated_header_not_green(self, hw):
+        assert not hw.tail_green("collecting ... collected 13 items")
+
+    def test_stderr_tail_after_marker_ignored(self, hw, tmp_path):
+        p = tmp_path / "cap.log"
+        p.write_text("13 passed in 4s\n" + hw.STDERR_MARKER
+                     + "\ncompilation: 1 error(s) detected\n")
+        assert hw.file_green(str(p))
+
+    def test_failure_before_marker_still_fails(self, hw, tmp_path):
+        p = tmp_path / "cap.log"
+        p.write_text("1 failed, 9 passed\n" + hw.STDERR_MARKER + "\nok\n")
+        assert not hw.file_green(str(p))
+
+
+class TestMicroComplete:
+    def test_final_emit_is_complete(self, hw, tmp_path):
+        p = tmp_path / "m.json"
+        p.write_text(json.dumps(
+            {"on_tpu": True, "speedup": 1.03, "total_sec": 23.6}))
+        assert hw.micro_complete(str(p))
+
+    def test_incremental_partial_not_complete(self, hw, tmp_path):
+        p = tmp_path / "m.json"
+        p.write_text(json.dumps({"on_tpu": True, "flash_ms": 23.7}))
+        assert not hw.micro_complete(str(p))
+
+    def test_cpu_fallback_not_complete(self, hw, tmp_path):
+        p = tmp_path / "m.json"
+        p.write_text(json.dumps({"on_tpu": False, "note": "not on TPU"}))
+        assert not hw.micro_complete(str(p))
+
+    def test_missing_or_malformed(self, hw, tmp_path):
+        assert not hw.micro_complete(str(tmp_path / "absent.json"))
+        p = tmp_path / "m.json"
+        p.write_text("{truncated")
+        assert not hw.micro_complete(str(p))
+
+
+class TestBenchComplete:
+    @staticmethod
+    def doc(on_tpu=True, partial=False, value=100.0):
+        probe = ({"stage": "probe", "ok": True, "platform": "tpu"}
+                 if on_tpu else
+                 {"stage": "probe", "ok": False, "err": "timeout"})
+        thr = {"stage": "throughput:lm", "rc": 0, "ok": True}
+        if partial:
+            thr["partial_rc"] = -9
+        return {"value": value, "stages": [probe, thr]}
+
+    def test_complete_tpu_run(self, hw, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps(self.doc()))
+        assert hw.bench_complete(str(p))
+
+    def test_cpu_fallback_rejected(self, hw, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps(self.doc(on_tpu=False)))
+        assert not hw.bench_complete(str(p))
+
+    def test_partial_stage_rejected(self, hw, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps(self.doc(partial=True)))
+        assert not hw.bench_complete(str(p))
+
+
+class TestStageDone:
+    def test_tier_retired_by_green_chunk_pair(self, hw, tmp_path):
+        (tmp_path / "tpu_tier_ops_tst.log").write_text("5 passed in 9s")
+        (tmp_path / "tpu_tier_rest_tst.log").write_text("8 passed in 30s")
+        assert hw.stage_done(hw.TIER)
+
+    def test_tier_pending_with_failing_chunk(self, hw, tmp_path):
+        (tmp_path / "tpu_tier_ops_tst.log").write_text("5 passed in 9s")
+        (tmp_path / "tpu_tier_rest_tst.log").write_text(
+            "1 failed, 7 passed in 30s")
+        assert not hw.stage_done(hw.TIER)
+
+    def test_tier_retired_by_legacy_whole_capture(self, hw, tmp_path):
+        (tmp_path / "tpu_tier_tst.log").write_text("13 passed in 45.9s")
+        assert hw.stage_done(hw.TIER)
+
+    def test_micro_stages_routed_to_micro_complete(self, hw, tmp_path):
+        for fname in ("micro_flash_tst.json", "micro_gqa_tst.json",
+                      "micro_lm_tst.json"):
+            (tmp_path / fname).write_text(json.dumps(
+                {"on_tpu": True, "total_sec": 9.0}))
+        for p in (hw.MICRO, hw.MICRO_GQA, hw.MICRO_LM):
+            assert hw.stage_done(p)
+
+    def test_absent_artifacts_pending(self, hw):
+        for p in (hw.BENCH, hw.GQA, hw.TIER, hw.MICRO, hw.MICRO_GQA,
+                  hw.MICRO_LM):
+            assert not hw.stage_done(p)
+
+
+class TestNextPartial:
+    def test_sequence(self, hw, tmp_path):
+        dst = str(tmp_path / "bench_tst.json")
+        assert hw.next_partial(dst) == str(tmp_path / "bench_tst_partial1.json")
+        (tmp_path / "bench_tst_partial1.json").write_text("{}")
+        assert hw.next_partial(dst) == str(tmp_path / "bench_tst_partial2.json")
