@@ -182,6 +182,37 @@ def test_lm_use_flash_false_matches_flash_path():
         np.asarray(out), np.asarray(out_xla), atol=1e-5)
 
 
+def test_lm_attn_window_plumbs_through_and_validates():
+    """attn_window must reach the attention op on both the flash and
+    use_flash=False paths (a silent drop would train full attention under a
+    local-attention config), and the config must reject the compositions
+    the kernels don't support."""
+    import pytest
+
+    base = dict(
+        vocab_size=128, num_layers=2, num_heads=2, d_model=32, d_ff=64,
+        max_len=64, dtype=jnp.float32,
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 64), 0, 128)
+    full = TransformerLM(TransformerConfig(**base))
+    windowed = TransformerLM(TransformerConfig(**base, attn_window=8))
+    windowed_xla = TransformerLM(
+        TransformerConfig(**base, attn_window=8, use_flash=False))
+    params = full.init(jax.random.PRNGKey(1), tokens)
+    out_full = full.apply(params, tokens)
+    out_w = windowed.apply(params, tokens)
+    out_w_xla = windowed_xla.apply(params, tokens)
+    # both windowed paths agree; both differ from full attention
+    np.testing.assert_allclose(
+        np.asarray(out_w), np.asarray(out_w_xla), atol=1e-5)
+    assert not np.allclose(np.asarray(out_w), np.asarray(out_full), atol=1e-3)
+
+    with pytest.raises(ValueError, match="causal"):
+        TransformerConfig(**{**base, "causal": False}, attn_window=8)
+    with pytest.raises(ValueError, match="decode"):
+        TransformerConfig(**base, attn_window=8, decode=True)
+
+
 class TestGenerate:
     """KV-cache decoding: the cached path must reproduce full-forward
     results token for token (prefill + T=1 steps vs O(T²) recompute)."""

@@ -246,6 +246,36 @@ class TestCompiledOnTPU:
                 np.asarray(got, np.float32), np.asarray(want, np.float32),
                 atol=0.1, rtol=0.1)
 
+    @pytest.mark.parametrize("t,w", [(256, 64), (300, 100)])
+    def test_sliding_window_compiled(self, t, w):
+        """Compiled sliding-window path: the block-liveness skip must not
+        drop live blocks (or keep dead ones) under Mosaic's real grid."""
+        q, k, v = qkv(t, d=64, dtype=jnp.bfloat16)
+        out = jax.jit(
+            lambda q, k, v: flash_attention(q, k, v, True, window=w)
+        )(q, k, v)
+        ref = xla_attention(*(x.astype(jnp.float32) for x in (q, k, v)),
+                            causal=True, window=w)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32),
+            atol=0.05, rtol=0.05)
+
+        def loss(attn, q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32) ** 2)
+
+        grads = jax.jit(jax.grad(
+            lambda q, k, v: loss(
+                lambda *a: flash_attention(*a, True, window=w), q, k, v),
+            argnums=(0, 1, 2)))(q, k, v)
+        refs = jax.jit(jax.grad(
+            lambda q, k, v: loss(
+                lambda *a: xla_attention(*a, causal=True, window=w), q, k, v),
+            argnums=(0, 1, 2)))(*(x.astype(jnp.float32) for x in (q, k, v)))
+        for got, want in zip(grads, refs):
+            np.testing.assert_allclose(
+                np.asarray(got, np.float32), np.asarray(want, np.float32),
+                atol=0.1, rtol=0.1)
+
 
 class TestFlashAttentionLse:
     """(o, lse) variant — ring attention's per-hop primitive.  The backward
@@ -395,3 +425,93 @@ def test_flash_autotune_candidate_blocks_interpret(bq, bk):
         np.asarray(dv),
         np.asarray(dvw.reshape(1, kv_h, h // kv_h, t, 32).sum(axis=2)),
         atol=1e-4)
+
+
+class TestSlidingWindow:
+    """Sliding-window (local) attention: the kernels' windowed mask +
+    block-liveness skip vs the closed-form windowed reference."""
+
+    @pytest.mark.parametrize("t,w,bq,bk", [
+        (256, 64, 128, 128),   # window < block: whole blocks die
+        (256, 128, 64, 64),    # window == block
+        (256, 200, 128, 128),  # window spans blocks unevenly
+        (100, 30, 64, 64),     # non-divisible seq len
+        (128, 1, 64, 64),      # degenerate: each token sees only itself
+        (128, 500, 64, 64),    # window > seq: must equal full causal
+    ])
+    def test_forward_matches_windowed_reference(self, t, w, bq, bk):
+        q, k, v = qkv(t, d=16)
+        out = flash_attention_interpret(q, k, v, True, None, bq, bk, window=w)
+        ref = xla_attention(q, k, v, causal=True, window=w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    def test_window_larger_than_seq_equals_full_causal(self):
+        q, k, v = qkv(128, d=16)
+        out = flash_attention_interpret(q, k, v, True, None, 64, 64, window=999)
+        ref = xla_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+    @pytest.mark.parametrize("t,w,bq,bk", [
+        (256, 64, 128, 128), (256, 200, 64, 64), (100, 30, 64, 64),
+    ])
+    def test_backward_matches_windowed_reference(self, t, w, bq, bk):
+        q, k, v = qkv(t, d=16)
+        g = jax.random.normal(jax.random.PRNGKey(11), q.shape)
+        out, dq, dk, dv = flash_attention_grads_interpret(
+            q, k, v, g, True, None, bq, bk, window=w)
+        ref, vjp = jax.vjp(
+            lambda q, k, v: xla_attention(q, k, v, causal=True, window=w),
+            q, k, v)
+        dq_ref, dk_ref, dv_ref = vjp(g)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_ref), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(dk_ref), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_ref), atol=1e-4)
+
+    def test_gqa_with_window(self):
+        t, h, kv_h, w = 128, 4, 2, 40
+        q, _, _ = qkv(t, d=16, b=1, h=h)
+        keys = jax.random.split(jax.random.PRNGKey(3), 2)
+        k = jax.random.normal(keys[0], (1, kv_h, t, 16))
+        v = jax.random.normal(keys[1], (1, kv_h, t, 16))
+        g = jax.random.normal(jax.random.PRNGKey(4), q.shape)
+        out, dq, dk, dv = flash_attention_grads_interpret(
+            q, k, v, g, True, None, 64, 64, window=w)
+        kw, vw = (jnp.repeat(x, h // kv_h, axis=1) for x in (k, v))
+        ref, vjp = jax.vjp(
+            lambda q, k, v: xla_attention(q, k, v, causal=True, window=w),
+            q, kw, vw)
+        dq_ref, dkw, dvw = vjp(g)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_ref), atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(dk),
+            np.asarray(dkw.reshape(1, kv_h, h // kv_h, t, 16).sum(axis=2)),
+            atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(dv),
+            np.asarray(dvw.reshape(1, kv_h, h // kv_h, t, 16).sum(axis=2)),
+            atol=1e-4)
+
+    def test_window_requires_causal(self):
+        q, k, v = qkv(64, d=16)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, False, window=32)
+
+    def test_negative_window_rejected(self):
+        q, k, v = qkv(64, d=16)
+        with pytest.raises(ValueError, match="positive"):
+            flash_attention(q, k, v, True, window=-4)
+
+    def test_fallback_path_honors_window(self):
+        """Off-TPU flash_attention routes to the XLA fallback — the window
+        must survive the dispatch (full attention would silently leak
+        future-but-distant context into every token)."""
+        if _on_tpu():
+            pytest.skip("exercises the CPU fallback dispatch")
+        q, k, v = qkv(128, d=16)
+        out = flash_attention(q, k, v, True, window=32)
+        ref = xla_attention(q, k, v, causal=True, window=32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        full = xla_attention(q, k, v, causal=True)
+        assert not np.allclose(np.asarray(out), np.asarray(full), atol=1e-3)

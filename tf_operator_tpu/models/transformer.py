@@ -73,6 +73,13 @@ class TransformerConfig:
     moe_every: int = 2
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
+    # Sliding-window (local) attention: each token attends to its last
+    # `attn_window` positions (0 = full attention; requires causal).  On
+    # TPU the flash kernels skip whole blocks outside the band, so
+    # attention compute drops from O(T^2) to O(T*window) — the
+    # long-context knob that composes with everything except sequence
+    # parallelism (ring/ulysses shard the full-attention pattern).
+    attn_window: int = 0
 
     def __post_init__(self):
         # A typo'd knob must not silently train the default architecture.
@@ -102,6 +109,26 @@ class TransformerConfig:
                 f"num_kv_heads {self.num_kv_heads} must be in [0, num_heads] "
                 f"and divide num_heads {self.num_heads}"
             )
+        if self.attn_window:
+            if self.attn_window < 0:
+                raise ValueError(
+                    f"attn_window must be >= 0, got {self.attn_window}")
+            if not self.causal:
+                raise ValueError(
+                    "attn_window (sliding-window attention) requires "
+                    "causal=True")
+            if self.decode:
+                raise ValueError(
+                    "attn_window is not supported in decode mode: the KV "
+                    "cache keeps max_len positions and decode attends the "
+                    "full prefix")
+            if (self.mesh is not None
+                    and self.ring_axis in self.mesh.axis_names
+                    and self.mesh.shape[self.ring_axis] > 1):
+                raise ValueError(
+                    "attn_window does not compose with sequence "
+                    "parallelism (ring/ulysses shard the full-attention "
+                    "pattern); drop the sp axis or the window")
 
 
 def rope(x, *, theta: float = 10000.0, positions=None):
@@ -185,11 +212,13 @@ class SelfAttention(nn.Module):
                         causal=cfg.causal, use_flash=cfg.use_flash,
                     )
             elif cfg.use_flash:
-                out = flash_attention(q, k, v, cfg.causal)
+                out = flash_attention(q, k, v, cfg.causal,
+                                      window=cfg.attn_window or None)
             else:
                 from ..ops.attention import repeat_kv
 
-                out = xla_attention(q, *repeat_kv(q, k, v), causal=cfg.causal)
+                out = xla_attention(q, *repeat_kv(q, k, v), causal=cfg.causal,
+                                    window=cfg.attn_window or None)
         out = out.transpose(0, 2, 1, 3)  # [B, T, H, D]
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out",

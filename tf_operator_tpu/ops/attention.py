@@ -47,6 +47,13 @@ dk/dv accumulate over the whole query group inside the dk/dv kernel (its
 innermost grid dim runs group × q-blocks), so the fwd+bwd K/V traffic is
 1/group of the repeat-outside approach the pure-XLA fallback uses.
 
+Sliding-window (local) attention is a first-class mask mode: `window=w`
+restricts each query to its last w keys (requires causal), and the same
+block-liveness predicate that skips causally-dead blocks also skips blocks
+outside the band — attention FLOPs drop from O(T^2) to O(T*w).  (The grid
+still visits every k-block, so the skip elides matmuls, not the K/V DMA;
+dead steps cost only their block fetch, which the pipeline overlaps.)
+
 Sequence-parallel long-context attention lives in parallel/ring_attention.py
 and composes with this kernel per-shard.
 """
@@ -89,6 +96,26 @@ def _causal_live(qi, ki, block_q: int, block_k: int):
     return (qi + 1) * block_q - 1 >= ki * block_k
 
 
+def _block_live(qi, ki, block_q: int, block_k: int, causal: bool,
+                window: Optional[int]):
+    """Whether block (qi, ki) has any unmasked position under the causal
+    and/or sliding-window masks — the grid-level FLOP-skip predicate.
+
+    The sliding window keeps q→k distances 0 <= q_pos - k_pos < window
+    (Mistral-style local attention; window implies causal — enforced at
+    the public entries).  A block is window-live when its *smallest*
+    achievable distance, first q row minus last k column, is < window;
+    with both masks, compute per q-block touches O(window) keys instead
+    of O(T), so the kernel's work drops from O(T^2) to O(T*window)."""
+    live = _causal_live(qi, ki, block_q, block_k) if causal else True
+    if window is not None:
+        live = jnp.logical_and(
+            live,
+            qi * block_q - (ki * block_k + block_k - 1) < window,
+        )
+    return live
+
+
 def _pad_seq(x, block: int):
     """Zero-pad dim -2 (seq) up to a multiple of `block`."""
     seq = x.shape[-2]
@@ -114,8 +141,8 @@ def _compiler_params(interpret: bool, semantics):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
-                causal: bool, block_q: int, block_k: int, num_kb: int,
-                real_len: int, seq_len: int):
+                causal: bool, window: Optional[int], block_q: int,
+                block_k: int, num_kb: int, real_len: int, seq_len: int):
     # rest = optional lse output ref, then the 3 VMEM scratch refs
     # (pallas passes refs positionally: inputs, outputs, scratch)
     maybe_lse_ref, (m_scr, l_scr, acc_scr) = rest[:-3], rest[-3:]
@@ -145,6 +172,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
         if causal:
             q_pos = qi * block_q + rows
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if window is not None:
+                s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if real_len < seq_len:
             s = jnp.where(k_pos < real_len, s, NEG_INF)  # padded keys
         m_prev = m_scr[...]                       # [block_q, LANE] replicated
@@ -168,7 +197,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
 
     if causal:
         # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
-        pl.when(_causal_live(qi, ki, block_q, block_k))(_compute)
+        pl.when(_block_live(qi, ki, block_q, block_k, causal, window))(_compute)
     else:
         _compute()
 
@@ -185,7 +214,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
 
 def _flash_forward(q, k, v, scale: float, causal: bool,
                    block_q: int, block_k: int, interpret: bool,
-                   save_lse: bool = True):
+                   save_lse: bool = True, window: Optional[int] = None):
     """Returns (out [B,H,T,D], lse [B*H, Tp] or None) — lse on the padded
     grid, compacted to one lane outside the kernel (the kernel emits the
     Mosaic-legal lane-replicated tile; carrying the residual at [bh, Tp]
@@ -214,8 +243,9 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
 
     grid = (bh, seq_len // block_q, num_kb)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_kb=num_kb, real_len=real_len, seq_len=seq_len,
+        _fwd_kernel, scale=scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, num_kb=num_kb, real_len=real_len,
+        seq_len=seq_len,
     )
     out_shape = [jax.ShapeDtypeStruct(qf.shape, q.dtype)]
     out_specs = [
@@ -260,7 +290,8 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *,
-                   scale: float, causal: bool, block_q: int, block_k: int,
+                   scale: float, causal: bool, window: Optional[int],
+                   block_q: int, block_k: int,
                    num_kb: int, real_len: int, seq_len: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -287,6 +318,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         if causal:
             q_pos = qi * block_q + rows
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if window is not None:
+                s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if real_len < seq_len:
             s = jnp.where(k_pos < real_len, s, NEG_INF)
         p = jnp.exp(s - lse)                 # [block_q, block_k]
@@ -304,7 +337,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     if causal:
         # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
-        pl.when(_causal_live(qi, ki, block_q, block_k))(_compute)
+        pl.when(_block_live(qi, ki, block_q, block_k, causal, window))(_compute)
     else:
         _compute()
 
@@ -315,7 +348,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
-                    causal: bool, block_q: int, block_k: int, num_qb: int,
+                    causal: bool, window: Optional[int], block_q: int,
+                    block_k: int, num_qb: int,
                     group: int, real_len: int, seq_len: int):
     # Innermost grid dim fuses (group member, q-block) group-major: dk/dv
     # for a KV head accumulate over every q-block of every query head in
@@ -347,6 +381,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k_pos = ki * block_k + cols
         if causal:
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            if window is not None:
+                s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
         if real_len < seq_len:
             # padded q rows: lse=0 would make p=exp(s) garbage; mask them
             s = jnp.where(q_pos < real_len, s, NEG_INF)
@@ -371,7 +407,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
-        pl.when(_causal_live(qi, ki, block_q, block_k))(_compute)
+        pl.when(_block_live(qi, ki, block_q, block_k, causal, window))(_compute)
     else:
         _compute()
 
@@ -383,7 +419,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
                     block_q: int, block_k: int, interpret: bool,
-                    g_lse=None):
+                    g_lse=None, window: Optional[int] = None):
     """dq/dk/dv for cotangent g on the output — and, when `g_lse` [bh, T] is
     given, also for a cotangent on the lse auxiliary output.  dlse folds
     into the existing row-scalar plumbing with no kernel change:
@@ -429,7 +465,7 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
 
     num_qb = seq_len // block_q
     num_kb = seq_len // block_k
-    common = dict(scale=scale, causal=causal, block_q=block_q,
+    common = dict(scale=scale, causal=causal, window=window, block_q=block_q,
                   block_k=block_k, real_len=real_len, seq_len=seq_len)
     # dq pass: grid (bh, q-block, k-block), K innermost (reduction);
     # GQA maps each query head to its KV head, as in the forward
@@ -488,9 +524,24 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
 # public op
 
 
-def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None):
+def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None,
+                  window: Optional[int] = None):
     """Plain-XLA attention (fallback + reference for kernel tests)."""
-    return xla_attention_lse(q, k, v, causal=causal, scale=scale)[0]
+    return xla_attention_lse(q, k, v, causal=causal, scale=scale,
+                             window=window)[0]
+
+
+def check_window(causal: bool, window: Optional[int]) -> Optional[int]:
+    """Normalize the sliding-window knob: None/0 -> full attention; a
+    positive window requires causal (Mistral-style local attention is a
+    causal mask restriction — bidirectional windows are not supported)."""
+    if not window:
+        return None
+    if window < 0:
+        raise ValueError(f"window must be positive, got {window}")
+    if not causal:
+        raise ValueError("sliding-window attention requires causal=True")
+    return int(window)
 
 
 def repeat_kv(q, k, v):
@@ -516,16 +567,16 @@ def _on_tpu() -> bool:
         return False
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash_attention_tpu(q, k, v, causal=True, scale=None,
-                         block_q=128, block_k=128):
+                         block_q=128, block_k=128, window=None):
     """The custom-vjp'd kernel path; flash_attention only routes here when
     _on_tpu() — no fallback branch, so a refactor that reaches this off-TPU
     fails loudly instead of silently paying the remat tax."""
     check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k,
-                            interpret=False, save_lse=False)
+                            interpret=False, save_lse=False, window=window)
     return out
 
 
@@ -563,10 +614,15 @@ def default_blocks(block_q, block_k):
 
 
 def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
-                    block_k=None):
+                    block_k=None, window=None):
     """Fused attention; Pallas kernels (fwd + bwd) on TPU, XLA elsewhere.
     k/v may carry fewer (grouped-query) heads than q — the kernels never
     repeat them in HBM; the XLA fallback widens them explicitly.
+
+    `window` (Mistral-style sliding window, requires causal) restricts
+    each query to its last `window` keys; on TPU the kernels skip every
+    block outside the band, so compute and K/V traffic drop from O(T^2)
+    to O(T*window).
 
     The platform dispatch happens OUTSIDE the custom_vjp: off-TPU the
     fallback runs plain xla_attention under standard autodiff.  Routing it
@@ -574,26 +630,29 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
     the backward (flash attention's memory-for-FLOPs remat trade) with no
     memory payoff — a measurable pure-overhead tax on the CPU arm
     (bench.py's CPU LM vs_baseline read ~0.97 from exactly this)."""
+    window = check_window(causal, window)
     if not _on_tpu():
         check_gqa(q, k)
         s = scale if scale is not None else q.shape[-1] ** -0.5
-        return xla_attention(q, *repeat_kv(q, k, v), causal=causal, scale=s)
-    return _flash_attention_tpu(q, k, v, causal, scale, block_q, block_k)
+        return xla_attention(q, *repeat_kv(q, k, v), causal=causal, scale=s,
+                             window=window)
+    return _flash_attention_tpu(q, k, v, causal, scale, block_q, block_k,
+                                window)
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k):
+def _fwd(q, k, v, causal, scale, block_q, block_k, window):
     check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
-                              interpret=False)
+                              interpret=False, window=window)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, scale, block_q, block_k, res, g):
+def _bwd(causal, scale, block_q, block_k, window, res, g):
     q, k, v, o, lse = res
     s = scale if scale is not None else q.shape[-1] ** -0.5
     return _flash_backward(q, k, v, o, lse, g, s, causal,
-                           block_q, block_k, interpret=False)
+                           block_q, block_k, interpret=False, window=window)
 
 
 _flash_attention_tpu.defvjp(_fwd, _bwd)
@@ -606,8 +665,12 @@ _flash_attention_tpu.defvjp(_fwd, _bwd)
 
 
 def xla_attention_lse(q, k, v, *, causal: bool = True,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None,
+                      window: Optional[int] = None):
     """Closed-form (o, lse [B,H,T] f32) — fallback + oracle for the kernel."""
+    # same contract as the kernel path: window implies causal (a silently
+    # ignored window in the reference would let oracle and kernel diverge)
+    window = check_window(causal, window)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     logits = jnp.einsum(
@@ -618,6 +681,8 @@ def xla_attention_lse(q, k, v, *, causal: bool = True,
         rows = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 0)
         cols = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
         logits = jnp.where(rows >= cols, logits, NEG_INF)
+        if window is not None:
+            logits = jnp.where(rows - cols < window, logits, NEG_INF)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     probs = jnp.exp(logits - lse[..., None]).astype(v.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v).astype(q.dtype)
@@ -678,25 +743,28 @@ flash_attention_lse.defvjp(_fwd_lse, _bwd_lse)
 
 
 def flash_attention_interpret(q, k, v, causal=True, scale=None,
-                              block_q=128, block_k=128):
+                              block_q=128, block_k=128, window=None):
     """Interpreter-mode forward kernel execution (the same primal-only
     no-lse variant the TPU compiles)."""
     s = scale if scale is not None else q.shape[-1] ** -0.5
+    window = check_window(causal, window)
     out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k,
-                            interpret=True, save_lse=False)
+                            interpret=True, save_lse=False, window=window)
     return out
 
 
 def flash_attention_grads_interpret(q, k, v, g, causal=True, scale=None,
-                                    block_q=128, block_k=128):
+                                    block_q=128, block_k=128, window=None):
     """Interpreter-mode fwd+bwd kernel execution: returns (out, dq, dk, dv)
     for cotangent g — the CPU-testable path through the SAME kernel code the
     TPU compiles."""
     s = scale if scale is not None else q.shape[-1] ** -0.5
+    window = check_window(causal, window)
     out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
-                              interpret=True)
+                              interpret=True, window=window)
     dq, dk, dv = _flash_backward(q, k, v, out, lse, g, s, causal,
-                                 block_q, block_k, interpret=True)
+                                 block_q, block_k, interpret=True,
+                                 window=window)
     return out, dq, dk, dv
 
 

@@ -51,6 +51,10 @@ def main(argv=None) -> int:
                              "llama: RoPE + RMSNorm + SwiGLU + GQA")
     parser.add_argument("--kv-heads", type=int, default=0,
                         help="GQA KV heads for --arch llama (0 = heads/3)")
+    parser.add_argument("--attn-window", type=int, default=0,
+                        help="sliding-window attention: each token attends "
+                             "its last N positions (0 = full; kernel skips "
+                             "blocks outside the band, O(T*N) compute)")
     parser.add_argument("--sample-tokens", type=int, default=0,
                         help="after training, greedily generate this many "
                              "tokens with the KV-cache decode path")
@@ -72,6 +76,12 @@ def main(argv=None) -> int:
         print(f"--sample-tokens {args.sample_tokens} needs prompt "
               f"({SAMPLE_PROMPT_LEN}) + tokens <= --seq-len {args.seq_len}",
               flush=True)
+        return 2
+    if args.sample_tokens > 0 and args.attn_window:
+        # generation re-derives a decode=True config, which rejects
+        # attn_window — fail before training, not after it
+        print("--sample-tokens does not support --attn-window (the KV-cache "
+              "decode path attends the full prefix)", flush=True)
         return 2
 
     ctx = WorkloadContext.from_env()
@@ -144,7 +154,8 @@ def main(argv=None) -> int:
             num_heads=heads, d_model=args.d_model,
             d_ff=d_ff, max_len=args.seq_len,
             mesh=mesh, ring_axis="sp", seq_parallel=args.seq_parallel,
-            remat=args.remat, moe_num_experts=args.moe_experts, **extra,
+            remat=args.remat, moe_num_experts=args.moe_experts,
+            attn_window=args.attn_window, **extra,
         )
     except ValueError as e:
         # e.g. --arch llama with an odd derived head_dim: a CLI-input
