@@ -438,6 +438,12 @@ class TestSlidingWindow:
         (100, 30, 64, 64),     # non-divisible seq len
         (128, 1, 64, 64),      # degenerate: each token sees only itself
         (128, 500, 64, 64),    # window > seq: must equal full causal
+        # long-T cases where the band is SHORTER than the k-block count:
+        # the banded grid (out-of-band blocks never DMA'd) is live here
+        (512, 64, 64, 64),     # k_band 3 of 8 blocks
+        (512, 100, 128, 64),   # asymmetric blocks, k_band 5 of 8
+        (512, 64, 64, 128),    # bq < bk: band origin mid-k-block
+        (448, 70, 64, 64),     # non-divisible long seq under banding
     ])
     def test_forward_matches_windowed_reference(self, t, w, bq, bk):
         q, k, v = qkv(t, d=16)
@@ -453,6 +459,10 @@ class TestSlidingWindow:
 
     @pytest.mark.parametrize("t,w,bq,bk", [
         (256, 64, 128, 128), (256, 200, 64, 64), (100, 30, 64, 64),
+        # banded-grid cases (band < block count) for dq's k-band and
+        # dk/dv's q-band, incl. asymmetric blocks and ragged length
+        (512, 64, 64, 64), (512, 100, 128, 64), (512, 100, 64, 128),
+        (448, 70, 64, 64),
     ])
     def test_backward_matches_windowed_reference(self, t, w, bq, bk):
         q, k, v = qkv(t, d=16)
@@ -467,6 +477,33 @@ class TestSlidingWindow:
         np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_ref), atol=1e-4)
         np.testing.assert_allclose(np.asarray(dk), np.asarray(dk_ref), atol=1e-4)
         np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_ref), atol=1e-4)
+
+    def test_gqa_with_banded_window(self):
+        """GQA × banded grid: the dk/dv q-band composes with the
+        group-major member indexing."""
+        t, h, kv_h, w = 512, 4, 2, 64
+        q, _, _ = qkv(t, d=16, b=1, h=h)
+        keys = jax.random.split(jax.random.PRNGKey(13), 2)
+        k = jax.random.normal(keys[0], (1, kv_h, t, 16))
+        v = jax.random.normal(keys[1], (1, kv_h, t, 16))
+        g = jax.random.normal(jax.random.PRNGKey(14), q.shape)
+        out, dq, dk, dv = flash_attention_grads_interpret(
+            q, k, v, g, True, None, 64, 64, window=w)
+        kw, vw = (jnp.repeat(x, h // kv_h, axis=1) for x in (k, v))
+        ref, vjp = jax.vjp(
+            lambda q, k, v: xla_attention(q, k, v, causal=True, window=w),
+            q, kw, vw)
+        dq_ref, dkw, dvw = vjp(g)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_ref), atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(dk),
+            np.asarray(dkw.reshape(1, kv_h, h // kv_h, t, 16).sum(axis=2)),
+            atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(dv),
+            np.asarray(dvw.reshape(1, kv_h, h // kv_h, t, 16).sum(axis=2)),
+            atol=1e-4)
 
     def test_gqa_with_window(self):
         t, h, kv_h, w = 128, 4, 2, 40

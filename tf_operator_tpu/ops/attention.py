@@ -48,11 +48,15 @@ innermost grid dim runs group × q-blocks), so the fwd+bwd K/V traffic is
 1/group of the repeat-outside approach the pure-XLA fallback uses.
 
 Sliding-window (local) attention is a first-class mask mode: `window=w`
-restricts each query to its last w keys (requires causal), and the same
-block-liveness predicate that skips causally-dead blocks also skips blocks
-outside the band — attention FLOPs drop from O(T^2) to O(T*w).  (The grid
-still visits every k-block, so the skip elides matmuls, not the K/V DMA;
-dead steps cost only their block fetch, which the pipeline overlaps.)
+restricts each query to its last w keys (requires causal).  The reduction
+grids themselves are BANDED when the window is shorter than the sequence
+(_k_band/_q_band): each q-block's grid only iterates the static-length
+band of k-blocks that can contain live positions (and dk/dv's per-k-block
+grid only its q-band), with the true block index recovered from the grid
+step and the overhang (up to band-1 steps where the band hangs off the
+array edge) clamped in the index map and skipped by pl.when.  Out-of-band blocks are therefore never even DMA'd —
+both FLOPs and K/V traffic drop from O(T^2) to O(T*w), which is the
+long-context win on TPU (VMEM use was already sequence-independent).
 
 Sequence-parallel long-context attention lives in parallel/ring_attention.py
 and composes with this kernel per-shard.
@@ -116,6 +120,45 @@ def _block_live(qi, ki, block_q: int, block_k: int, causal: bool,
     return live
 
 
+def _k_band(window: Optional[int], block_q: int, block_k: int,
+            num_kb: int) -> Optional[int]:
+    """Length of the banded reduction grid over k-blocks for one q-block
+    under the sliding window, or None for the full grid.  The live
+    k-blocks for q-block i span kb_lo..kb_hi with
+    kb_hi = ((i+1)*block_q - 1) // block_k and
+    kb_lo = max(0, (i*block_q - window + 1) // block_k), so their count
+    is bounded by (block_q + window - 2) // block_k + 2 independent of i —
+    a STATIC grid length; the kernels recover the true k-block index from
+    (i, j) and skip the overhang (up to k_band-1 steps at the array edge).
+    Banding the grid — rather than pl.when alone — is what saves the K/V
+    DMA, not just the FLOPs: blocks outside the band are never fetched."""
+    if window is None:
+        return None
+    band = (block_q + window - 2) // block_k + 2
+    return band if band < num_kb else None
+
+
+def _q_band(window: Optional[int], block_q: int, block_k: int,
+            num_qb: int) -> Optional[int]:
+    """Banded grid length over q-blocks for one k-block (the dk/dv
+    reduction): live q-blocks span qb_lo = (k*block_k) // block_q up to
+    the last row within the window, a count bounded by
+    (block_k + window - 2) // block_q + 2."""
+    if window is None:
+        return None
+    band = (block_k + window - 2) // block_q + 2
+    return band if band < num_qb else None
+
+
+def _band_kb(qi, ki, block_q: int, block_k: int, k_band: int):
+    """True k-block index for banded-grid reduction step ki at q-block qi:
+    the band ends at the diagonal block kb_hi and extends k_band steps back.
+    SHARED by the fwd/dq kernels and their K/V BlockSpec index maps — the
+    mask and the DMA must agree on which block a grid step means (the maps
+    clamp negative overhang to 0; the kernels skip it via kb >= 0)."""
+    return ((qi + 1) * block_q - 1) // block_k - (k_band - 1) + ki
+
+
 def _pad_seq(x, block: int):
     """Zero-pad dim -2 (seq) up to a multiple of `block`."""
     seq = x.shape[-2]
@@ -142,12 +185,22 @@ def _compiler_params(interpret: bool, semantics):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
                 causal: bool, window: Optional[int], block_q: int,
-                block_k: int, num_kb: int, real_len: int, seq_len: int):
+                block_k: int, num_kb: int, real_len: int, seq_len: int,
+                k_band: Optional[int] = None):
     # rest = optional lse output ref, then the 3 VMEM scratch refs
     # (pallas passes refs positionally: inputs, outputs, scratch)
+    # num_kb is the reduction-grid LENGTH (the k-band under a sliding
+    # window); k_band set means grid step ki maps to true k-block index
+    # kb = _band_kb(qi, ki, ...), where negative kb is the (clamped,
+    # skipped) overhang — up to k_band-1 steps — before the band enters
+    # the array.
     maybe_lse_ref, (m_scr, l_scr, acc_scr) = rest[:-3], rest[-3:]
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    if k_band is None:
+        kb = ki
+    else:
+        kb = _band_kb(qi, ki, block_q, block_k, k_band)
     head_dim = q_ref.shape[-1]
 
     @pl.when(ki == 0)
@@ -168,7 +221,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
-        k_pos = ki * block_k + cols
+        k_pos = kb * block_k + cols
         if causal:
             q_pos = qi * block_q + rows
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
@@ -197,7 +250,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
 
     if causal:
         # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
-        pl.when(_block_live(qi, ki, block_q, block_k, causal, window))(_compute)
+        live = _block_live(qi, kb, block_q, block_k, causal, window)
+        if k_band is not None:
+            live = jnp.logical_and(live, kb >= 0)  # pre-array overhang
+        pl.when(live)(_compute)
     else:
         _compute()
 
@@ -240,12 +296,16 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
     vf = _pad_seq(vf, seq_len)
     bh = batch * heads
     num_kb = seq_len // block_k
+    # Sliding window: iterate only the k-band per q-block (static length),
+    # so out-of-band K/V blocks are never DMA'd — see _k_band.
+    k_band = _k_band(window, block_q, block_k, num_kb)
+    grid_k = k_band if k_band is not None else num_kb
 
-    grid = (bh, seq_len // block_q, num_kb)
+    grid = (bh, seq_len // block_q, grid_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, window=window,
-        block_q=block_q, block_k=block_k, num_kb=num_kb, real_len=real_len,
-        seq_len=seq_len,
+        block_q=block_q, block_k=block_k, num_kb=grid_k, real_len=real_len,
+        seq_len=seq_len, k_band=k_band,
     )
     out_shape = [jax.ShapeDtypeStruct(qf.shape, q.dtype)]
     out_specs = [
@@ -261,9 +321,17 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
         pltpu.VMEM((block_q, LANE), jnp.float32),       # l
         pltpu.VMEM((block_q, head_dim), jnp.float32),   # acc
     ]
-    kvspec = pl.BlockSpec(
-        (1, block_k, head_dim), lambda b, i, j: (b // group, j, 0)
-    )
+    if k_band is None:
+        kvspec = pl.BlockSpec(
+            (1, block_k, head_dim), lambda b, i, j: (b // group, j, 0)
+        )
+    else:
+        def kv_map(b, i, j):
+            return (b // group,
+                    jnp.maximum(_band_kb(i, j, block_q, block_k, k_band), 0),
+                    0)
+
+        kvspec = pl.BlockSpec((1, block_k, head_dim), kv_map)
     res = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shape),
@@ -292,9 +360,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_scr, *,
                    scale: float, causal: bool, window: Optional[int],
                    block_q: int, block_k: int,
-                   num_kb: int, real_len: int, seq_len: int):
+                   num_kb: int, real_len: int, seq_len: int,
+                   k_band: Optional[int] = None):
+    # num_kb is the reduction-grid length; under a k-band (sliding window)
+    # the true k-block index is recovered from (qi, ki) as in _fwd_kernel.
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    if k_band is None:
+        kb = ki
+    else:
+        kb = _band_kb(qi, ki, block_q, block_k, k_band)
 
     @pl.when(ki == 0)
     def _init():
@@ -314,7 +389,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        k_pos = ki * block_k + cols
+        k_pos = kb * block_k + cols
         if causal:
             q_pos = qi * block_q + rows
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
@@ -337,7 +412,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     if causal:
         # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
-        pl.when(_block_live(qi, ki, block_q, block_k, causal, window))(_compute)
+        live = _block_live(qi, kb, block_q, block_k, causal, window)
+        if k_band is not None:
+            live = jnp.logical_and(live, kb >= 0)
+        pl.when(live)(_compute)
     else:
         _compute()
 
@@ -350,13 +428,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
                     causal: bool, window: Optional[int], block_q: int,
                     block_k: int, num_qb: int,
-                    group: int, real_len: int, seq_len: int):
+                    group: int, real_len: int, seq_len: int,
+                    q_band: Optional[int] = None,
+                    num_qb_total: Optional[int] = None):
     # Innermost grid dim fuses (group member, q-block) group-major: dk/dv
     # for a KV head accumulate over every q-block of every query head in
-    # its group before the single write-out.
+    # its group before the single write-out.  num_qb is the per-member
+    # grid length (the q-band under a sliding window); with q_band set,
+    # the true q-block index is qb_lo + (j % q_band) where
+    # qb_lo = (ki*block_k) // block_q, and steps past num_qb_total-1 are
+    # clamped overhang (skipped).
     ki = pl.program_id(1)
     j = pl.program_id(2)
-    qi = j % num_qb
+    if q_band is None:
+        qi = j % num_qb
+    else:
+        if num_qb_total is None:
+            raise ValueError("q_band requires num_qb_total (the real "
+                             "q-block count) for the overhang skip")
+        qi = (ki * block_k) // block_q + (j % q_band)
 
     @pl.when(j == 0)
     def _init():
@@ -407,7 +497,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
-        pl.when(_block_live(qi, ki, block_q, block_k, causal, window))(_compute)
+        live = _block_live(qi, ki, block_q, block_k, causal, window)
+        if q_band is not None:
+            live = jnp.logical_and(live, qi <= num_qb_total - 1)
+        pl.when(live)(_compute)
     else:
         _compute()
 
@@ -467,18 +560,31 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     num_kb = seq_len // block_k
     common = dict(scale=scale, causal=causal, window=window, block_q=block_q,
                   block_k=block_k, real_len=real_len, seq_len=seq_len)
+    # Sliding window: both backward passes iterate only their band (see
+    # _k_band/_q_band) so out-of-band blocks are never DMA'd.
+    k_band = _k_band(window, block_q, block_k, num_kb)
+    grid_k = k_band if k_band is not None else num_kb
     # dq pass: grid (bh, q-block, k-block), K innermost (reduction);
     # GQA maps each query head to its KV head, as in the forward
     qspec = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0))
-    kspec_j = pl.BlockSpec(
-        (1, block_k, head_dim), lambda b, i, j: (b // group, j, 0)
-    )
+    if k_band is None:
+        kspec_j = pl.BlockSpec(
+            (1, block_k, head_dim), lambda b, i, j: (b // group, j, 0)
+        )
+    else:
+        def kv_map(b, i, j):
+            return (b // group,
+                    jnp.maximum(_band_kb(i, j, block_q, block_k, k_band), 0),
+                    0)
+
+        kspec_j = pl.BlockSpec((1, block_k, head_dim), kv_map)
     rowspec_q = pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, num_kb=num_kb, **common),
+        functools.partial(_bwd_dq_kernel, num_kb=grid_k, k_band=k_band,
+                          **common),
         out_shape=jax.ShapeDtypeStruct(qf.shape, q.dtype),
-        grid=(bh, num_qb, num_kb),
+        grid=(bh, num_qb, grid_k),
         in_specs=[qspec, kspec_j, kspec_j, qspec, rowspec_q, rowspec_q],
         out_specs=qspec,
         scratch_shapes=[pltpu.VMEM((block_q, head_dim), jnp.float32)],
@@ -489,21 +595,31 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     # dk/dv pass: grid (B*Hkv, k-block, group×q-block), Q innermost
     # (reduction over every q-block of every query head in the group).
     # From kv index b: q flat index = (b//Hkv)*H + (b%Hkv)*group + member.
+    q_band = _q_band(window, block_q, block_k, num_qb)
+    grid_q = q_band if q_band is not None else num_qb
+
     def q_side(b, i, j):
-        return ((b // kv_heads) * heads + (b % kv_heads) * group + j // num_qb,
-                j % num_qb, 0)
+        member = j // grid_q
+        qb = j % grid_q
+        if q_band is not None:
+            qb = jnp.minimum(
+                (i * block_k) // block_q + qb, num_qb - 1
+            )
+        return ((b // kv_heads) * heads + (b % kv_heads) * group + member,
+                qb, 0)
 
     qspec_j = pl.BlockSpec((1, block_q, head_dim), q_side)
     kspec_i = pl.BlockSpec((1, block_k, head_dim), lambda b, i, j: (b, i, 0))
     rowspec_j = pl.BlockSpec((1, block_q, LANE), q_side)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, num_qb=num_qb, group=group,
+        functools.partial(_bwd_dkv_kernel, num_qb=grid_q, group=group,
+                          q_band=q_band, num_qb_total=num_qb,
                           **common),
         out_shape=(
             jax.ShapeDtypeStruct(kf.shape, k.dtype),
             jax.ShapeDtypeStruct(vf.shape, v.dtype),
         ),
-        grid=(batch * kv_heads, num_kb, num_qb * group),
+        grid=(batch * kv_heads, num_kb, grid_q * group),
         in_specs=[qspec_j, kspec_i, kspec_i, qspec_j, rowspec_j, rowspec_j],
         out_specs=(kspec_i, kspec_i),
         scratch_shapes=[
