@@ -260,7 +260,24 @@ def _attention_ladder(platform, stages):
     gqa = run_child("attention:gqa", gqa_env)
     if parsed is not None and gqa is not None:
         parsed["gqa_arm"] = gqa
-    return parsed if parsed is not None else gqa
+    # Sliding-window arm: windowed vs full-causal flash — the banded-grid
+    # long-context factor.  On CPU it prices only the fallback masks
+    # (default window sized to the short CPU rungs).
+    win_env = {"BENCH_ATTN_WINDOW": os.environ.get(
+        "BENCH_ATTN_WINDOW_SIZE", "1024" if platform is not None else "128")}
+    if platform is not None:
+        win_env["BENCH_ATTN_SEQS"] = os.environ.get(
+            "BENCH_ATTN_WIN_SEQS", "4096,8192")
+    win = run_child("attention:window", win_env)
+    # Attach arms to whichever child succeeded: a main-arm failure must not
+    # discard arm rows that already spent (scarce) chip time.
+    base = parsed if parsed is not None else (gqa if gqa is not None else win)
+    if base is not None:
+        if gqa is not None and base is not gqa:
+            base["gqa_arm"] = gqa
+        if win is not None and base is not win:
+            base["window_arm"] = win
+    return base
 
 
 def _control_plane(stages):
@@ -674,6 +691,9 @@ def child_attention() -> None:
     # speedup row also prices the avoided repeat traffic.
     kv_h = int(os.environ.get("BENCH_ATTN_KV_H", str(h)))
     reps = int(os.environ.get("BENCH_ATTN_REPS", "5"))
+    # Sliding-window arm: time windowed flash vs full-causal flash at the
+    # same seq — the banded-grid win (O(T*w) FLOPs+DMA vs O(T^2)).
+    window = int(os.environ.get("BENCH_ATTN_WINDOW", "0")) or None
     rows = []
     for t in seqs:
         key = jax.random.PRNGKey(0)
@@ -703,22 +723,44 @@ def child_attention() -> None:
         if kv_h != h:
             row["kv_heads"] = kv_h
 
-        def widened_xla(q, k, v):
-            return xla_attention(q, *repeat_kv(q, k, v), causal=True)
+        if window:
+            # Window arm: full-causal flash is the baseline (XLA would
+            # conflate the mask change with the kernel difference).  Skips
+            # the XLA/autotune section and falls through to the common
+            # per-row emit.
+            row["window"] = window
+            full_s = win_s = None
+            try:
+                full_s = timed(lambda q, k, v: flash_attention(q, k, v, True))
+                row["flash_full_ms"] = round(full_s * 1e3, 3)
+            except Exception as e:  # noqa: BLE001
+                row["flash_full_error"] = repr(e)[:200]
+            try:
+                win_s = timed(lambda q, k, v: flash_attention(
+                    q, k, v, True, window=window))
+                row["flash_window_ms"] = round(win_s * 1e3, 3)
+            except Exception as e:  # noqa: BLE001
+                row["flash_window_error"] = repr(e)[:200]
+            if full_s and win_s:
+                row["window_speedup"] = round(full_s / win_s, 3)
+            flash_s = xla_s = None  # no tune gate for this arm
+        else:
+            def widened_xla(q, k, v):
+                return xla_attention(q, *repeat_kv(q, k, v), causal=True)
 
-        flash_s = xla_s = None
-        try:
-            flash_s = timed(lambda q, k, v: flash_attention(q, k, v, True))
-            row["flash_ms"] = round(flash_s * 1e3, 3)
-        except Exception as e:  # noqa: BLE001
-            row["flash_error"] = repr(e)[:200]
-        try:
-            xla_s = timed(widened_xla)
-            row["xla_ms"] = round(xla_s * 1e3, 3)
-        except Exception as e:  # noqa: BLE001 — e.g. OOM on the O(T²) path
-            row["xla_error"] = repr(e)[:200]
-        if flash_s and xla_s:  # ratio from raw timings, rounded for display
-            row["speedup"] = round(xla_s / flash_s, 3)
+            flash_s = xla_s = None
+            try:
+                flash_s = timed(lambda q, k, v: flash_attention(q, k, v, True))
+                row["flash_ms"] = round(flash_s * 1e3, 3)
+            except Exception as e:  # noqa: BLE001
+                row["flash_error"] = repr(e)[:200]
+            try:
+                xla_s = timed(widened_xla)
+                row["xla_ms"] = round(xla_s * 1e3, 3)
+            except Exception as e:  # noqa: BLE001 — e.g. OOM on the O(T²) path
+                row["xla_error"] = repr(e)[:200]
+            if flash_s and xla_s:  # ratio from raw timings, rounded for display
+                row["speedup"] = round(xla_s / flash_s, 3)
         # Tune-until-it-wins (VERDICT r03 #2): when the default 128x128
         # tiling doesn't clearly beat XLA on chip, search block shapes and
         # record the tuned number alongside.  "auto" gates on the observed

@@ -159,6 +159,24 @@ def _band_kb(qi, ki, block_q: int, block_k: int, k_band: int):
     return ((qi + 1) * block_q - 1) // block_k - (k_band - 1) + ki
 
 
+def _kv_block_spec(block_q: int, block_k: int, head_dim: int, group: int,
+                   k_band: Optional[int]):
+    """K/V BlockSpec for a (bh, q-block, k-step) grid — full reduction or
+    banded.  One definition for the forward and dq passes so their DMA
+    index math cannot drift."""
+    if k_band is None:
+        return pl.BlockSpec(
+            (1, block_k, head_dim), lambda b, i, j: (b // group, j, 0)
+        )
+
+    def kv_map(b, i, j):
+        return (b // group,
+                jnp.maximum(_band_kb(i, j, block_q, block_k, k_band), 0),
+                0)
+
+    return pl.BlockSpec((1, block_k, head_dim), kv_map)
+
+
 def _pad_seq(x, block: int):
     """Zero-pad dim -2 (seq) up to a multiple of `block`."""
     seq = x.shape[-2]
@@ -321,17 +339,7 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
         pltpu.VMEM((block_q, LANE), jnp.float32),       # l
         pltpu.VMEM((block_q, head_dim), jnp.float32),   # acc
     ]
-    if k_band is None:
-        kvspec = pl.BlockSpec(
-            (1, block_k, head_dim), lambda b, i, j: (b // group, j, 0)
-        )
-    else:
-        def kv_map(b, i, j):
-            return (b // group,
-                    jnp.maximum(_band_kb(i, j, block_q, block_k, k_band), 0),
-                    0)
-
-        kvspec = pl.BlockSpec((1, block_k, head_dim), kv_map)
+    kvspec = _kv_block_spec(block_q, block_k, head_dim, group, k_band)
     res = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shape),
@@ -567,17 +575,7 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     # dq pass: grid (bh, q-block, k-block), K innermost (reduction);
     # GQA maps each query head to its KV head, as in the forward
     qspec = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0))
-    if k_band is None:
-        kspec_j = pl.BlockSpec(
-            (1, block_k, head_dim), lambda b, i, j: (b // group, j, 0)
-        )
-    else:
-        def kv_map(b, i, j):
-            return (b // group,
-                    jnp.maximum(_band_kb(i, j, block_q, block_k, k_band), 0),
-                    0)
-
-        kspec_j = pl.BlockSpec((1, block_k, head_dim), kv_map)
+    kspec_j = _kv_block_spec(block_q, block_k, head_dim, group, k_band)
     rowspec_q = pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
