@@ -117,11 +117,6 @@ class TransformerConfig:
                 raise ValueError(
                     "attn_window (sliding-window attention) requires "
                     "causal=True")
-            if self.decode:
-                raise ValueError(
-                    "attn_window is not supported in decode mode: the KV "
-                    "cache keeps max_len positions and decode attends the "
-                    "full prefix")
             if (self.mesh is not None
                     and self.ring_axis in self.mesh.axis_names
                     and self.mesh.shape[self.ring_axis] > 1):
@@ -234,23 +229,103 @@ class SelfAttention(nn.Module):
         path.  RoPE rotates by absolute positions (cache index + row).
         Grouped KV stays grouped in the cache; the widen happens on the
         tiny per-step score computation only.
+
+        With attn_window set, the cache is a ROLLING buffer of
+        min(window, max_len) slots (Mistral-style): position p writes slot
+        p % C, a per-slot absolute-position record drives the window mask
+        (slot p1=0 means empty), and cache memory is O(window) instead of
+        O(max_len).  Multi-token calls attend the cached keys plus the
+        call's own k/v under one absolute-position mask — correct both
+        from a fresh cache (models/generate.py's single prefill) and from
+        a partially filled one (chunked prefill) — and store the chunk's
+        last C tokens; T=1 steps attend the rolling buffer.
         """
         cfg = self.cfg
         batch, _, t, head_dim = q.shape
         kv_heads = k.shape[1]
+        window = cfg.attn_window or None
+        cap = min(window, cfg.max_len) if window else cfg.max_len
         cache_k = self.variable(
             "cache", "cached_key", jnp.zeros,
-            (batch, kv_heads, cfg.max_len, head_dim), cfg.dtype)
+            (batch, kv_heads, cap, head_dim), cfg.dtype)
         cache_v = self.variable(
             "cache", "cached_value", jnp.zeros,
-            (batch, kv_heads, cfg.max_len, head_dim), cfg.dtype)
+            (batch, kv_heads, cap, head_dim), cfg.dtype)
         cache_i = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32))
+        if window:
+            # absolute position + 1 per slot; 0 = empty (so the zero-filled
+            # fresh cache from generate._fresh_cache reads as empty)
+            cache_p1 = self.variable(
+                "cache", "cached_pos1", jnp.zeros, (cap,), jnp.int32)
         pos0 = cache_i.value
         if cfg.use_rope:
             positions = pos0 + jnp.arange(t)
             q = rope(q, theta=cfg.rope_theta, positions=positions)
             k = rope(k, theta=cfg.rope_theta, positions=positions)
+
+        from ..ops.attention import repeat_kv
+
+        scale = head_dim ** -0.5
+        if window and t > 1:
+            # Rolling-cache (chunked) prefill: attend the cached keys AND
+            # this call's own k/v under one absolute-position window mask —
+            # correct from an empty cache (all slots p1=0, fully masked)
+            # and from a partially filled one (chunked prefill /
+            # accepted-speculation appends), matching the non-windowed
+            # path's contract.  Then store the chunk's last `cap` tokens —
+            # whose slots p % C are distinct.
+            k_all = jnp.concatenate(
+                [cache_k.value.astype(k.dtype), k], axis=2)
+            v_all = jnp.concatenate(
+                [cache_v.value.astype(v.dtype), v], axis=2)
+            kw, vw = repeat_kv(q, k_all, v_all)
+            logits = jnp.einsum(
+                "bhqd,bhkd->bhqk", q, kw, preferred_element_type=jnp.float32
+            ) * scale
+            q_pos = pos0 + jnp.arange(t)
+            k_abs = jnp.concatenate(
+                [cache_p1.value - 1, pos0 + jnp.arange(t)])
+            valid = ((k_abs[None, :] >= 0)
+                     & (k_abs[None, :] <= q_pos[:, None])
+                     & (q_pos[:, None] - k_abs[None, :] < window))
+            logits = jnp.where(valid[None, None], logits, NEG_INF)
+            probs = jax.nn.softmax(logits, axis=-1).astype(vw.dtype)
+            out = jnp.einsum(
+                "bhqk,bhkd->bhqd", probs, vw).astype(q.dtype)
+            keep = min(cap, t)
+            kept_pos = pos0 + jnp.arange(t - keep, t)
+            slots = kept_pos % cap
+            cache_k.value = cache_k.value.at[:, :, slots, :].set(
+                k[:, :, t - keep:, :].astype(cfg.dtype))
+            cache_v.value = cache_v.value.at[:, :, slots, :].set(
+                v[:, :, t - keep:, :].astype(cfg.dtype))
+            cache_p1.value = cache_p1.value.at[slots].set(kept_pos + 1)
+            cache_i.value = pos0 + t
+            return out
+        if window:
+            # T=1 rolling step: write slot pos % C, mask by per-slot
+            # absolute position (empty slots p1=0 never pass k_abs >= 0).
+            slot = pos0 % cap
+            kf = lax.dynamic_update_slice(
+                cache_k.value, k.astype(cfg.dtype), (0, 0, slot, 0))
+            vf = lax.dynamic_update_slice(
+                cache_v.value, v.astype(cfg.dtype), (0, 0, slot, 0))
+            p1 = lax.dynamic_update_slice(
+                cache_p1.value, (pos0 + 1)[None].astype(jnp.int32), (slot,))
+            cache_k.value, cache_v.value, cache_p1.value = kf, vf, p1
+            cache_i.value = pos0 + 1
+            kf, vf = repeat_kv(q, kf, vf)
+            logits = jnp.einsum(
+                "bhqd,bhkd->bhqk", q, kf, preferred_element_type=jnp.float32
+            ) * scale
+            k_abs = p1 - 1
+            valid = ((k_abs >= 0) & (k_abs <= pos0)
+                     & (pos0 - k_abs < window))
+            logits = jnp.where(valid[None, None, None, :], logits, NEG_INF)
+            probs = jax.nn.softmax(logits, axis=-1).astype(vf.dtype)
+            return jnp.einsum("bhqk,bhkd->bhqd", probs, vf).astype(q.dtype)
+
         kf = lax.dynamic_update_slice(cache_k.value, k.astype(cfg.dtype),
                                       (0, 0, pos0, 0))
         vf = lax.dynamic_update_slice(cache_v.value, v.astype(cfg.dtype),
@@ -258,10 +333,7 @@ class SelfAttention(nn.Module):
         cache_k.value, cache_v.value = kf, vf
         cache_i.value = pos0 + t
 
-        from ..ops.attention import repeat_kv
-
         kf, vf = repeat_kv(q, kf, vf)
-        scale = head_dim ** -0.5
         logits = jnp.einsum(
             "bhqd,bhkd->bhqk", q, kf, preferred_element_type=jnp.float32
         ) * scale

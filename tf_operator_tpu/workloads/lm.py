@@ -77,12 +77,6 @@ def main(argv=None) -> int:
               f"({SAMPLE_PROMPT_LEN}) + tokens <= --seq-len {args.seq_len}",
               flush=True)
         return 2
-    if args.sample_tokens > 0 and args.attn_window:
-        # generation re-derives a decode=True config, which rejects
-        # attn_window — fail before training, not after it
-        print("--sample-tokens does not support --attn-window (the KV-cache "
-              "decode path attends the full prefix)", flush=True)
-        return 2
 
     ctx = WorkloadContext.from_env()
     print(f"lm workload: role={ctx.replica_type} index={ctx.replica_index} "
