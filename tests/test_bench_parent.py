@@ -96,16 +96,21 @@ def test_attention_timeout_marks_partial(monkeypatch):
     gqa_rows = _json({"fwd_bwd": [{"seq": 1024, "flash_ms": 1.2,
                                    "kv_heads": 4}],
                       "shape": {}, "kernel_path": "pallas"})
-    # main ladder times out mid-run; the gqa arm then completes
-    outcomes = [(-9, rows), (0, gqa_rows)]
+    win_rows = _json({"fwd_bwd": [{"seq": 4096, "window": 1024,
+                                   "window_speedup": 2.0}],
+                      "shape": {}, "kernel_path": "pallas"})
+    # main ladder times out mid-run; the gqa and window arms then complete
+    outcomes = [(-9, rows), (0, gqa_rows), (0, win_rows)]
     calls = run_script(monkeypatch, outcomes)
     stages = []
     result = bench._attention_ladder("tpu", stages)
     assert result["partial_rc"] == -9
     assert "partial" in result
-    assert len(calls) == 2
+    assert len(calls) == 3
     assert result["gqa_arm"]["fwd_bwd"][0]["kv_heads"] == 4
-    assert [s["stage"] for s in stages] == ["attention", "attention:gqa"]
+    assert result["window_arm"]["fwd_bwd"][0]["window"] == 1024
+    assert [s["stage"] for s in stages] == [
+        "attention", "attention:gqa", "attention:window"]
 
 
 def test_attention_gqa_arm_env(monkeypatch):
@@ -113,7 +118,7 @@ def test_attention_gqa_arm_env(monkeypatch):
     monkeypatch.delenv("BENCH_SKIP_ATTENTION", raising=False)
     monkeypatch.delenv("BENCH_ATTN_GQA_SEQS", raising=False)
     ok = _json({"fwd_bwd": [], "shape": {}, "kernel_path": "pallas"})
-    outcomes = [(0, ok), (0, ok)]
+    outcomes = [(0, ok), (0, ok), (0, ok)]
     envs = []
 
     def fake_run(cmd, env_extra, timeout):
@@ -125,6 +130,8 @@ def test_attention_gqa_arm_env(monkeypatch):
     assert "BENCH_ATTN_KV_H" not in envs[0]
     assert envs[1]["BENCH_ATTN_KV_H"] == "4"
     assert envs[1]["BENCH_ATTN_SEQS"] == "1024,4096"
+    assert envs[2]["BENCH_ATTN_WINDOW"] == "1024"
+    assert envs[2]["BENCH_ATTN_SEQS"] == "4096,8192"
 
 
 def test_cpu_fallback_single_rung(monkeypatch):

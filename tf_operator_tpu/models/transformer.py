@@ -80,6 +80,11 @@ class TransformerConfig:
     # long-context knob that composes with everything except sequence
     # parallelism (ring/ulysses shard the full-attention pattern).
     attn_window: int = 0
+    # Attention sinks (StreamingLLM): with a window, additionally keep the
+    # first `attn_sink` absolute positions visible to every token — the
+    # fix for the quality collapse of pure sliding windows once the
+    # earliest tokens roll out of range.  Requires attn_window > 0.
+    attn_sink: int = 0
 
     def __post_init__(self):
         # A typo'd knob must not silently train the default architecture.
@@ -124,6 +129,20 @@ class TransformerConfig:
                     "attn_window does not compose with sequence "
                     "parallelism (ring/ulysses shard the full-attention "
                     "pattern); drop the sp axis or the window")
+        if self.attn_sink:
+            if self.attn_sink < 0:
+                raise ValueError(
+                    f"attn_sink must be >= 0, got {self.attn_sink}")
+            if not self.attn_window:
+                raise ValueError(
+                    "attn_sink requires attn_window > 0 (without a window "
+                    "every position already attends the first tokens)")
+            if self.attn_sink >= self.max_len:
+                raise ValueError(
+                    f"attn_sink ({self.attn_sink}) must be < max_len "
+                    f"({self.max_len}): a sink covering every position is "
+                    "full attention, and the rolling decode cache needs at "
+                    "least one non-sink slot")
 
 
 def rope(x, *, theta: float = 10000.0, positions=None):
@@ -208,12 +227,14 @@ class SelfAttention(nn.Module):
                     )
             elif cfg.use_flash:
                 out = flash_attention(q, k, v, cfg.causal,
-                                      window=cfg.attn_window or None)
+                                      window=cfg.attn_window or None,
+                                      sink=cfg.attn_sink)
             else:
                 from ..ops.attention import repeat_kv
 
                 out = xla_attention(q, *repeat_kv(q, k, v), causal=cfg.causal,
-                                    window=cfg.attn_window or None)
+                                    window=cfg.attn_window or None,
+                                    sink=cfg.attn_sink)
         out = out.transpose(0, 2, 1, 3)  # [B, T, H, D]
         return nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), dtype=cfg.dtype, name="out",
@@ -231,20 +252,27 @@ class SelfAttention(nn.Module):
         tiny per-step score computation only.
 
         With attn_window set, the cache is a ROLLING buffer of
-        min(window, max_len) slots (Mistral-style): position p writes slot
-        p % C, a per-slot absolute-position record drives the window mask
-        (slot p1=0 means empty), and cache memory is O(window) instead of
+        min(sink + window, max_len) slots (Mistral-style): the first
+        `attn_sink` slots are PINNED to absolute positions 0..sink-1
+        (StreamingLLM sinks, never evicted), position p >= sink writes
+        slot sink + (p - sink) % (C - sink), and a per-slot
+        absolute-position record drives the window|sink mask (slot p1=0
+        means empty) — cache memory is O(sink + window) instead of
         O(max_len).  Multi-token calls attend the cached keys plus the
         call's own k/v under one absolute-position mask — correct both
         from a fresh cache (models/generate.py's single prefill) and from
         a partially filled one (chunked prefill) — and store the chunk's
-        last C tokens; T=1 steps attend the rolling buffer.
+        sink-destined tokens plus its last C - sink others; T=1 steps
+        attend the rolling buffer.
         """
         cfg = self.cfg
         batch, _, t, head_dim = q.shape
         kv_heads = k.shape[1]
         window = cfg.attn_window or None
-        cap = min(window, cfg.max_len) if window else cfg.max_len
+        sink = cfg.attn_sink if window else 0
+        # cap is bounded by max_len: positions never exceed it, so a
+        # clamped roll region cannot evict an in-window key.
+        cap = min(sink + window, cfg.max_len) if window else cfg.max_len
         cache_k = self.variable(
             "cache", "cached_key", jnp.zeros,
             (batch, kv_heads, cap, head_dim), cfg.dtype)
@@ -269,12 +297,14 @@ class SelfAttention(nn.Module):
         scale = head_dim ** -0.5
         if window and t > 1:
             # Rolling-cache (chunked) prefill: attend the cached keys AND
-            # this call's own k/v under one absolute-position window mask —
-            # correct from an empty cache (all slots p1=0, fully masked)
-            # and from a partially filled one (chunked prefill /
+            # this call's own k/v under one absolute-position window|sink
+            # mask — correct from an empty cache (all slots p1=0, fully
+            # masked) and from a partially filled one (chunked prefill /
             # accepted-speculation appends), matching the non-windowed
-            # path's contract.  Then store the chunk's last `cap` tokens —
-            # whose slots p % C are distinct.
+            # path's contract.  The store below keeps sink-destined tokens
+            # at their pinned slots plus the chunk's last cap - sink
+            # others (distinct rolling slots); everything else routes to
+            # the out-of-range drop slot.
             k_all = jnp.concatenate(
                 [cache_k.value.astype(k.dtype), k], axis=2)
             v_all = jnp.concatenate(
@@ -286,27 +316,39 @@ class SelfAttention(nn.Module):
             q_pos = pos0 + jnp.arange(t)
             k_abs = jnp.concatenate(
                 [cache_p1.value - 1, pos0 + jnp.arange(t)])
+            in_window = q_pos[:, None] - k_abs[None, :] < window
+            if sink:
+                in_window = in_window | (k_abs[None, :] < sink)
             valid = ((k_abs[None, :] >= 0)
                      & (k_abs[None, :] <= q_pos[:, None])
-                     & (q_pos[:, None] - k_abs[None, :] < window))
+                     & in_window)
             logits = jnp.where(valid[None, None], logits, NEG_INF)
             probs = jax.nn.softmax(logits, axis=-1).astype(vw.dtype)
             out = jnp.einsum(
                 "bhqk,bhkd->bhqd", probs, vw).astype(q.dtype)
-            keep = min(cap, t)
-            kept_pos = pos0 + jnp.arange(t - keep, t)
-            slots = kept_pos % cap
+            # Store: sink-destined chunk tokens at their pinned slots plus
+            # the last (cap - sink) others rolling; the rest are routed to
+            # the out-of-range slot `cap` and dropped by the scatter.
+            roll = cap - sink
+            chunk_pos = pos0 + jnp.arange(t)
+            slots = jnp.where(chunk_pos < sink, chunk_pos,
+                              sink + (chunk_pos - sink) % roll)
+            keep_mask = (chunk_pos < sink) | (chunk_pos >= pos0 + t - roll)
+            slots = jnp.where(keep_mask, slots, cap)
             cache_k.value = cache_k.value.at[:, :, slots, :].set(
-                k[:, :, t - keep:, :].astype(cfg.dtype))
+                k.astype(cfg.dtype), mode="drop")
             cache_v.value = cache_v.value.at[:, :, slots, :].set(
-                v[:, :, t - keep:, :].astype(cfg.dtype))
-            cache_p1.value = cache_p1.value.at[slots].set(kept_pos + 1)
+                v.astype(cfg.dtype), mode="drop")
+            cache_p1.value = cache_p1.value.at[slots].set(
+                chunk_pos + 1, mode="drop")
             cache_i.value = pos0 + t
             return out
         if window:
-            # T=1 rolling step: write slot pos % C, mask by per-slot
+            # T=1 rolling step: sink positions write their pinned slot,
+            # the rest roll over the tail region; mask by per-slot
             # absolute position (empty slots p1=0 never pass k_abs >= 0).
-            slot = pos0 % cap
+            slot = jnp.where(pos0 < sink, pos0,
+                             sink + (pos0 - sink) % (cap - sink))
             kf = lax.dynamic_update_slice(
                 cache_k.value, k.astype(cfg.dtype), (0, 0, slot, 0))
             vf = lax.dynamic_update_slice(
@@ -320,8 +362,10 @@ class SelfAttention(nn.Module):
                 "bhqd,bhkd->bhqk", q, kf, preferred_element_type=jnp.float32
             ) * scale
             k_abs = p1 - 1
-            valid = ((k_abs >= 0) & (k_abs <= pos0)
-                     & (pos0 - k_abs < window))
+            in_window = pos0 - k_abs < window
+            if sink:
+                in_window = in_window | (k_abs < sink)
+            valid = (k_abs >= 0) & (k_abs <= pos0) & in_window
             logits = jnp.where(valid[None, None, None, :], logits, NEG_INF)
             probs = jax.nn.softmax(logits, axis=-1).astype(vf.dtype)
             return jnp.einsum("bhqk,bhkd->bhqd", probs, vf).astype(q.dtype)

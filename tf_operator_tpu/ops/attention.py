@@ -54,9 +54,17 @@ grids themselves are BANDED when the window is shorter than the sequence
 band of k-blocks that can contain live positions (and dk/dv's per-k-block
 grid only its q-band), with the true block index recovered from the grid
 step and the overhang (up to band-1 steps where the band hangs off the
-array edge) clamped in the index map and skipped by pl.when.  Out-of-band blocks are therefore never even DMA'd —
-both FLOPs and K/V traffic drop from O(T^2) to O(T*w), which is the
-long-context win on TPU (VMEM use was already sequence-independent).
+array edge) clamped in the index map and skipped by pl.when.  Out-of-band
+blocks are therefore never even DMA'd — both FLOPs and K/V traffic drop
+from O(T^2) to O(T*w), which is the long-context win on TPU (VMEM use was
+already sequence-independent).
+
+Attention sinks (`sink=s`, StreamingLLM-style) keep the first s absolute
+positions visible to every query on top of the window.  The fwd/dq grids
+gain a ceil(s/block_k)-step PREFIX mapping to the sink blocks before the
+band (with a dedup guard where they overlap), so a tiny sink costs one
+extra grid step; dk/dv reverts to the full grid + liveness skip (sink
+k-blocks are attended by every q-block, so no contiguous q-band exists).
 
 Sequence-parallel long-context attention lives in parallel/ring_attention.py
 and composes with this kernel per-shard.
@@ -101,27 +109,32 @@ def _causal_live(qi, ki, block_q: int, block_k: int):
 
 
 def _block_live(qi, ki, block_q: int, block_k: int, causal: bool,
-                window: Optional[int]):
+                window: Optional[int], sink: int = 0):
     """Whether block (qi, ki) has any unmasked position under the causal
-    and/or sliding-window masks — the grid-level FLOP-skip predicate.
+    and/or sliding-window(+sink) masks — the grid-level FLOP-skip
+    predicate.
 
     The sliding window keeps q→k distances 0 <= q_pos - k_pos < window
     (Mistral-style local attention; window implies causal — enforced at
     the public entries).  A block is window-live when its *smallest*
     achievable distance, first q row minus last k column, is < window;
     with both masks, compute per q-block touches O(window) keys instead
-    of O(T), so the kernel's work drops from O(T^2) to O(T*window)."""
+    of O(T), so the kernel's work drops from O(T^2) to O(T*window).
+
+    `sink` (StreamingLLM-style attention sinks) additionally keeps the
+    first `sink` absolute key positions live for every query: a block
+    overlapping [0, sink) stays live regardless of distance."""
     live = _causal_live(qi, ki, block_q, block_k) if causal else True
     if window is not None:
-        live = jnp.logical_and(
-            live,
-            qi * block_q - (ki * block_k + block_k - 1) < window,
-        )
+        in_band = qi * block_q - (ki * block_k + block_k - 1) < window
+        if sink:
+            in_band = jnp.logical_or(in_band, ki * block_k < sink)
+        live = jnp.logical_and(live, in_band)
     return live
 
 
 def _k_band(window: Optional[int], block_q: int, block_k: int,
-            num_kb: int) -> Optional[int]:
+            num_kb: int, sink: int = 0) -> Optional[int]:
     """Length of the banded reduction grid over k-blocks for one q-block
     under the sliding window, or None for the full grid.  The live
     k-blocks for q-block i span kb_lo..kb_hi with
@@ -131,20 +144,32 @@ def _k_band(window: Optional[int], block_q: int, block_k: int,
     a STATIC grid length; the kernels recover the true k-block index from
     (i, j) and skip the overhang (up to k_band-1 steps at the array edge).
     Banding the grid — rather than pl.when alone — is what saves the K/V
-    DMA, not just the FLOPs: blocks outside the band are never fetched."""
+    DMA, not just the FLOPs: blocks outside the band are never fetched.
+
+    With attention sinks the reduction grid gets a PREFIX of
+    ceil(sink/block_k) steps that map straight to the first k-blocks (the
+    sink region), followed by the diagonal band — so a canonical tiny
+    sink costs one extra grid step, not the whole O(T^2) grid.  Returns
+    the band length EXCLUDING the prefix; callers add _sink_blocks()."""
     if window is None:
         return None
     band = (block_q + window - 2) // block_k + 2
-    return band if band < num_kb else None
+    return band if _sink_blocks(sink, block_k) + band < num_kb else None
+
+
+def _sink_blocks(sink: int, block_k: int) -> int:
+    """Number of k-blocks overlapping the sink prefix [0, sink)."""
+    return -(-sink // block_k) if sink else 0
 
 
 def _q_band(window: Optional[int], block_q: int, block_k: int,
-            num_qb: int) -> Optional[int]:
+            num_qb: int, sink: int = 0) -> Optional[int]:
     """Banded grid length over q-blocks for one k-block (the dk/dv
     reduction): live q-blocks span qb_lo = (k*block_k) // block_q up to
     the last row within the window, a count bounded by
-    (block_k + window - 2) // block_q + 2."""
-    if window is None:
+    (block_k + window - 2) // block_q + 2.  Disabled when sinks are on
+    (sink k-blocks are attended by EVERY q-block — no contiguous band)."""
+    if window is None or sink:
         return None
     band = (block_k + window - 2) // block_q + 2
     return band if band < num_qb else None
@@ -160,7 +185,7 @@ def _band_kb(qi, ki, block_q: int, block_k: int, k_band: int):
 
 
 def _kv_block_spec(block_q: int, block_k: int, head_dim: int, group: int,
-                   k_band: Optional[int]):
+                   k_band: Optional[int], sink: int = 0):
     """K/V BlockSpec for a (bh, q-block, k-step) grid — full reduction or
     banded.  One definition for the forward and dq passes so their DMA
     index math cannot drift."""
@@ -168,11 +193,13 @@ def _kv_block_spec(block_q: int, block_k: int, head_dim: int, group: int,
         return pl.BlockSpec(
             (1, block_k, head_dim), lambda b, i, j: (b // group, j, 0)
         )
+    sb = _sink_blocks(sink, block_k)
 
     def kv_map(b, i, j):
-        return (b // group,
-                jnp.maximum(_band_kb(i, j, block_q, block_k, k_band), 0),
-                0)
+        banded = jnp.maximum(
+            _band_kb(i, j - sb, block_q, block_k, k_band), 0)
+        kb = jnp.where(j < sb, j, banded) if sb else banded
+        return (b // group, kb, 0)
 
     return pl.BlockSpec((1, block_k, head_dim), kv_map)
 
@@ -204,7 +231,7 @@ def _compiler_params(interpret: bool, semantics):
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
                 causal: bool, window: Optional[int], block_q: int,
                 block_k: int, num_kb: int, real_len: int, seq_len: int,
-                k_band: Optional[int] = None):
+                k_band: Optional[int] = None, sink: int = 0):
     # rest = optional lse output ref, then the 3 VMEM scratch refs
     # (pallas passes refs positionally: inputs, outputs, scratch)
     # num_kb is the reduction-grid LENGTH (the k-band under a sliding
@@ -218,7 +245,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
     if k_band is None:
         kb = ki
     else:
-        kb = _band_kb(qi, ki, block_q, block_k, k_band)
+        sb = _sink_blocks(sink, block_k)
+        banded = _band_kb(qi, ki - sb, block_q, block_k, k_band)
+        kb = jnp.where(ki < sb, ki, banded) if sb else banded
     head_dim = q_ref.shape[-1]
 
     @pl.when(ki == 0)
@@ -244,7 +273,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
             q_pos = qi * block_q + rows
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
             if window is not None:
-                s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
+                keep = q_pos - k_pos < window
+                if sink:
+                    keep = jnp.logical_or(keep, k_pos < sink)
+                s = jnp.where(keep, s, NEG_INF)
         if real_len < seq_len:
             s = jnp.where(k_pos < real_len, s, NEG_INF)  # padded keys
         m_prev = m_scr[...]                       # [block_q, LANE] replicated
@@ -268,9 +300,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
 
     if causal:
         # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
-        live = _block_live(qi, kb, block_q, block_k, causal, window)
+        live = _block_live(qi, kb, block_q, block_k, causal, window, sink)
         if k_band is not None:
-            live = jnp.logical_and(live, kb >= 0)  # pre-array overhang
+            # banded steps skip the pre-array overhang AND any block the
+            # sink prefix already processed (dedup); prefix steps pass.
+            sb = _sink_blocks(sink, block_k)
+            live = jnp.logical_and(
+                live, jnp.logical_or(ki < sb, kb >= sb))
         pl.when(live)(_compute)
     else:
         _compute()
@@ -288,7 +324,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
 
 def _flash_forward(q, k, v, scale: float, causal: bool,
                    block_q: int, block_k: int, interpret: bool,
-                   save_lse: bool = True, window: Optional[int] = None):
+                   save_lse: bool = True, window: Optional[int] = None,
+                   sink: int = 0):
     """Returns (out [B,H,T,D], lse [B*H, Tp] or None) — lse on the padded
     grid, compacted to one lane outside the kernel (the kernel emits the
     Mosaic-legal lane-replicated tile; carrying the residual at [bh, Tp]
@@ -316,14 +353,15 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
     num_kb = seq_len // block_k
     # Sliding window: iterate only the k-band per q-block (static length),
     # so out-of-band K/V blocks are never DMA'd — see _k_band.
-    k_band = _k_band(window, block_q, block_k, num_kb)
-    grid_k = k_band if k_band is not None else num_kb
+    k_band = _k_band(window, block_q, block_k, num_kb, sink)
+    grid_k = (_sink_blocks(sink, block_k) + k_band
+              if k_band is not None else num_kb)
 
     grid = (bh, seq_len // block_q, grid_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, num_kb=grid_k, real_len=real_len,
-        seq_len=seq_len, k_band=k_band,
+        seq_len=seq_len, k_band=k_band, sink=sink,
     )
     out_shape = [jax.ShapeDtypeStruct(qf.shape, q.dtype)]
     out_specs = [
@@ -339,7 +377,8 @@ def _flash_forward(q, k, v, scale: float, causal: bool,
         pltpu.VMEM((block_q, LANE), jnp.float32),       # l
         pltpu.VMEM((block_q, head_dim), jnp.float32),   # acc
     ]
-    kvspec = _kv_block_spec(block_q, block_k, head_dim, group, k_band)
+    kvspec = _kv_block_spec(block_q, block_k, head_dim, group, k_band,
+                            sink)
     res = pl.pallas_call(
         kernel,
         out_shape=tuple(out_shape),
@@ -369,7 +408,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    scale: float, causal: bool, window: Optional[int],
                    block_q: int, block_k: int,
                    num_kb: int, real_len: int, seq_len: int,
-                   k_band: Optional[int] = None):
+                   k_band: Optional[int] = None, sink: int = 0):
     # num_kb is the reduction-grid length; under a k-band (sliding window)
     # the true k-block index is recovered from (qi, ki) as in _fwd_kernel.
     qi = pl.program_id(1)
@@ -377,7 +416,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     if k_band is None:
         kb = ki
     else:
-        kb = _band_kb(qi, ki, block_q, block_k, k_band)
+        sb = _sink_blocks(sink, block_k)
+        banded = _band_kb(qi, ki - sb, block_q, block_k, k_band)
+        kb = jnp.where(ki < sb, ki, banded) if sb else banded
 
     @pl.when(ki == 0)
     def _init():
@@ -402,7 +443,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q_pos = qi * block_q + rows
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
             if window is not None:
-                s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
+                keep = q_pos - k_pos < window
+                if sink:
+                    keep = jnp.logical_or(keep, k_pos < sink)
+                s = jnp.where(keep, s, NEG_INF)
         if real_len < seq_len:
             s = jnp.where(k_pos < real_len, s, NEG_INF)
         p = jnp.exp(s - lse)                 # [block_q, block_k]
@@ -420,9 +464,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     if causal:
         # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
-        live = _block_live(qi, kb, block_q, block_k, causal, window)
+        live = _block_live(qi, kb, block_q, block_k, causal, window, sink)
         if k_band is not None:
-            live = jnp.logical_and(live, kb >= 0)
+            # banded steps skip the pre-array overhang AND any block the
+            # sink prefix already processed (dedup); prefix steps pass.
+            sb = _sink_blocks(sink, block_k)
+            live = jnp.logical_and(
+                live, jnp.logical_or(ki < sb, kb >= sb))
         pl.when(live)(_compute)
     else:
         _compute()
@@ -438,7 +486,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     block_k: int, num_qb: int,
                     group: int, real_len: int, seq_len: int,
                     q_band: Optional[int] = None,
-                    num_qb_total: Optional[int] = None):
+                    num_qb_total: Optional[int] = None, sink: int = 0):
     # Innermost grid dim fuses (group member, q-block) group-major: dk/dv
     # for a KV head accumulate over every q-block of every query head in
     # its group before the single write-out.  num_qb is the per-member
@@ -480,7 +528,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
             if window is not None:
-                s = jnp.where(q_pos - k_pos < window, s, NEG_INF)
+                keep = q_pos - k_pos < window
+                if sink:
+                    keep = jnp.logical_or(keep, k_pos < sink)
+                s = jnp.where(keep, s, NEG_INF)
         if real_len < seq_len:
             # padded q rows: lse=0 would make p=exp(s) garbage; mask them
             s = jnp.where(q_pos < real_len, s, NEG_INF)
@@ -505,7 +556,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
-        live = _block_live(qi, ki, block_q, block_k, causal, window)
+        live = _block_live(qi, ki, block_q, block_k, causal, window, sink)
         if q_band is not None:
             live = jnp.logical_and(live, qi <= num_qb_total - 1)
         pl.when(live)(_compute)
@@ -520,7 +571,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
                     block_q: int, block_k: int, interpret: bool,
-                    g_lse=None, window: Optional[int] = None):
+                    g_lse=None, window: Optional[int] = None, sink: int = 0):
     """dq/dk/dv for cotangent g on the output — and, when `g_lse` [bh, T] is
     given, also for a cotangent on the lse auxiliary output.  dlse folds
     into the existing row-scalar plumbing with no kernel change:
@@ -566,16 +617,19 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
 
     num_qb = seq_len // block_q
     num_kb = seq_len // block_k
-    common = dict(scale=scale, causal=causal, window=window, block_q=block_q,
+    common = dict(scale=scale, causal=causal, window=window, sink=sink,
+                  block_q=block_q,
                   block_k=block_k, real_len=real_len, seq_len=seq_len)
     # Sliding window: both backward passes iterate only their band (see
     # _k_band/_q_band) so out-of-band blocks are never DMA'd.
-    k_band = _k_band(window, block_q, block_k, num_kb)
-    grid_k = k_band if k_band is not None else num_kb
+    k_band = _k_band(window, block_q, block_k, num_kb, sink)
+    grid_k = (_sink_blocks(sink, block_k) + k_band
+              if k_band is not None else num_kb)
     # dq pass: grid (bh, q-block, k-block), K innermost (reduction);
     # GQA maps each query head to its KV head, as in the forward
     qspec = pl.BlockSpec((1, block_q, head_dim), lambda b, i, j: (b, i, 0))
-    kspec_j = _kv_block_spec(block_q, block_k, head_dim, group, k_band)
+    kspec_j = _kv_block_spec(block_q, block_k, head_dim, group, k_band,
+                             sink)
     rowspec_q = pl.BlockSpec((1, block_q, LANE), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
@@ -593,7 +647,7 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
     # dk/dv pass: grid (B*Hkv, k-block, group×q-block), Q innermost
     # (reduction over every q-block of every query head in the group).
     # From kv index b: q flat index = (b//Hkv)*H + (b%Hkv)*group + member.
-    q_band = _q_band(window, block_q, block_k, num_qb)
+    q_band = _q_band(window, block_q, block_k, num_qb, sink)
     grid_q = q_band if q_band is not None else num_qb
 
     def q_side(b, i, j):
@@ -639,10 +693,25 @@ def _flash_backward(q, k, v, o, lse, g, scale: float, causal: bool,
 
 
 def xla_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None,
-                  window: Optional[int] = None):
+                  window: Optional[int] = None, sink: int = 0):
     """Plain-XLA attention (fallback + reference for kernel tests)."""
     return xla_attention_lse(q, k, v, causal=causal, scale=scale,
-                             window=window)[0]
+                             window=window, sink=sink)[0]
+
+
+def check_sink(window: Optional[int], sink: int) -> int:
+    """Normalize the attention-sink knob: 0 = none; positive requires a
+    sliding window (sinks only change behavior when distant context is
+    otherwise masked off)."""
+    if not sink:
+        return 0
+    if sink < 0:
+        raise ValueError(f"sink must be >= 0, got {sink}")
+    if window is None:
+        raise ValueError(
+            "attention sinks require a sliding window (without one every "
+            "position already attends the first tokens)")
+    return int(sink)
 
 
 def check_window(causal: bool, window: Optional[int]) -> Optional[int]:
@@ -681,16 +750,17 @@ def _on_tpu() -> bool:
         return False
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_attention_tpu(q, k, v, causal=True, scale=None,
-                         block_q=128, block_k=128, window=None):
+                         block_q=128, block_k=128, window=None, sink=0):
     """The custom-vjp'd kernel path; flash_attention only routes here when
     _on_tpu() — no fallback branch, so a refactor that reaches this off-TPU
     fails loudly instead of silently paying the remat tax."""
     check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k,
-                            interpret=False, save_lse=False, window=window)
+                            interpret=False, save_lse=False, window=window,
+                            sink=sink)
     return out
 
 
@@ -728,7 +798,7 @@ def default_blocks(block_q, block_k):
 
 
 def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
-                    block_k=None, window=None):
+                    block_k=None, window=None, sink=0):
     """Fused attention; Pallas kernels (fwd + bwd) on TPU, XLA elsewhere.
     k/v may carry fewer (grouped-query) heads than q — the kernels never
     repeat them in HBM; the XLA fallback widens them explicitly.
@@ -745,28 +815,30 @@ def flash_attention(q, k, v, causal=True, scale=None, block_q=None,
     memory payoff — a measurable pure-overhead tax on the CPU arm
     (bench.py's CPU LM vs_baseline read ~0.97 from exactly this)."""
     window = check_window(causal, window)
+    sink = check_sink(window, sink)
     if not _on_tpu():
         check_gqa(q, k)
         s = scale if scale is not None else q.shape[-1] ** -0.5
         return xla_attention(q, *repeat_kv(q, k, v), causal=causal, scale=s,
-                             window=window)
+                             window=window, sink=sink)
     return _flash_attention_tpu(q, k, v, causal, scale, block_q, block_k,
-                                window)
+                                window, sink)
 
 
-def _fwd(q, k, v, causal, scale, block_q, block_k, window):
+def _fwd(q, k, v, causal, scale, block_q, block_k, window, sink):
     check_gqa(q, k)
     s = scale if scale is not None else q.shape[-1] ** -0.5
     out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
-                              interpret=False, window=window)
+                              interpret=False, window=window, sink=sink)
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, scale, block_q, block_k, window, res, g):
+def _bwd(causal, scale, block_q, block_k, window, sink, res, g):
     q, k, v, o, lse = res
     s = scale if scale is not None else q.shape[-1] ** -0.5
     return _flash_backward(q, k, v, o, lse, g, s, causal,
-                           block_q, block_k, interpret=False, window=window)
+                           block_q, block_k, interpret=False, window=window,
+                           sink=sink)
 
 
 _flash_attention_tpu.defvjp(_fwd, _bwd)
@@ -780,11 +852,12 @@ _flash_attention_tpu.defvjp(_fwd, _bwd)
 
 def xla_attention_lse(q, k, v, *, causal: bool = True,
                       scale: Optional[float] = None,
-                      window: Optional[int] = None):
+                      window: Optional[int] = None, sink: int = 0):
     """Closed-form (o, lse [B,H,T] f32) — fallback + oracle for the kernel."""
     # same contract as the kernel path: window implies causal (a silently
     # ignored window in the reference would let oracle and kernel diverge)
     window = check_window(causal, window)
+    sink = check_sink(window, sink)
     if scale is None:
         scale = q.shape[-1] ** -0.5
     logits = jnp.einsum(
@@ -796,7 +869,10 @@ def xla_attention_lse(q, k, v, *, causal: bool = True,
         cols = lax.broadcasted_iota(jnp.int32, (t_q, t_k), 1)
         logits = jnp.where(rows >= cols, logits, NEG_INF)
         if window is not None:
-            logits = jnp.where(rows - cols < window, logits, NEG_INF)
+            keep = rows - cols < window
+            if sink:
+                keep = jnp.logical_or(keep, cols < sink)
+            logits = jnp.where(keep, logits, NEG_INF)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     probs = jnp.exp(logits - lse[..., None]).astype(v.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v).astype(q.dtype)
@@ -857,28 +933,32 @@ flash_attention_lse.defvjp(_fwd_lse, _bwd_lse)
 
 
 def flash_attention_interpret(q, k, v, causal=True, scale=None,
-                              block_q=128, block_k=128, window=None):
+                              block_q=128, block_k=128, window=None, sink=0):
     """Interpreter-mode forward kernel execution (the same primal-only
     no-lse variant the TPU compiles)."""
     s = scale if scale is not None else q.shape[-1] ** -0.5
     window = check_window(causal, window)
+    sink = check_sink(window, sink)
     out, _ = _flash_forward(q, k, v, s, causal, block_q, block_k,
-                            interpret=True, save_lse=False, window=window)
+                            interpret=True, save_lse=False, window=window,
+                            sink=sink)
     return out
 
 
 def flash_attention_grads_interpret(q, k, v, g, causal=True, scale=None,
-                                    block_q=128, block_k=128, window=None):
+                                    block_q=128, block_k=128, window=None,
+                                    sink=0):
     """Interpreter-mode fwd+bwd kernel execution: returns (out, dq, dk, dv)
     for cotangent g — the CPU-testable path through the SAME kernel code the
     TPU compiles."""
     s = scale if scale is not None else q.shape[-1] ** -0.5
     window = check_window(causal, window)
+    sink = check_sink(window, sink)
     out, lse = _flash_forward(q, k, v, s, causal, block_q, block_k,
-                              interpret=True, window=window)
+                              interpret=True, window=window, sink=sink)
     dq, dk, dv = _flash_backward(q, k, v, out, lse, g, s, causal,
                                  block_q, block_k, interpret=True,
-                                 window=window)
+                                 window=window, sink=sink)
     return out, dq, dk, dv
 
 

@@ -55,6 +55,10 @@ def main(argv=None) -> int:
                         help="sliding-window attention: each token attends "
                              "its last N positions (0 = full; kernel skips "
                              "blocks outside the band, O(T*N) compute)")
+    parser.add_argument("--attn-sink", type=int, default=0,
+                        help="attention sinks (StreamingLLM): with "
+                             "--attn-window, keep the first N positions "
+                             "visible to every token")
     parser.add_argument("--sample-tokens", type=int, default=0,
                         help="after training, greedily generate this many "
                              "tokens with the KV-cache decode path")
@@ -149,7 +153,7 @@ def main(argv=None) -> int:
             d_ff=d_ff, max_len=args.seq_len,
             mesh=mesh, ring_axis="sp", seq_parallel=args.seq_parallel,
             remat=args.remat, moe_num_experts=args.moe_experts,
-            attn_window=args.attn_window, **extra,
+            attn_window=args.attn_window, attn_sink=args.attn_sink, **extra,
         )
     except ValueError as e:
         # e.g. --arch llama with an odd derived head_dim: a CLI-input
