@@ -8,9 +8,10 @@ jax` itself, so the probe must be a killable child), and when it is live,
 burn down the pending hardware-evidence list in priority order:
 
   1. the micro probes (build/micro_tpu_probe.py, micro_gqa_probe.py,
-     micro_lm_probe.py) — each sized for a ~1-2 minute window; together
-     they cover flash-vs-XLA perf, compiled-GQA numerics+perf, and LM
-     tokens/sec+MFU on chip even if no window ever fits the bench
+     micro_lm_probe.py, micro_window_probe.py) — each sized for a ~1-2
+     minute window; together they cover flash-vs-XLA perf, compiled-GQA
+     numerics+perf, LM tokens/sec+MFU, and the banded sliding-window/
+     sink kernels on chip even if no window ever fits the bench
   2. full bench with the LM model first (LM tokens/sec + MFU, then the
      flash-vs-XLA attention ladder, then the second model) -> bench JSON
   3. GQA compiled kernel tests (`pytest -m tpu -k gqa`)
@@ -50,10 +51,12 @@ TIER_OPS = os.path.join(ART, f"tpu_tier_ops_{STAMP}.log")
 TIER_REST = os.path.join(ART, f"tpu_tier_rest_{STAMP}.log")
 MICRO = os.path.join(ART, f"micro_flash_{STAMP}.json")
 # Window-sized companions to the flash micro (see build/micro_*_probe.py):
-# compiled-GQA numerics+timing and LM tokens/sec+MFU — together they cover
-# the verdict's three on-chip asks even if no window ever fits the bench.
+# compiled-GQA numerics+timing, LM tokens/sec+MFU, and the banded
+# sliding-window/sink kernels — together they cover the on-chip evidence
+# set even if no tunnel window ever fits the bench.
 MICRO_GQA = os.path.join(ART, f"micro_gqa_{STAMP}.json")
 MICRO_LM = os.path.join(ART, f"micro_lm_{STAMP}.json")
+MICRO_WIN = os.path.join(ART, f"micro_window_{STAMP}.json")
 
 
 def log(msg: str) -> None:
@@ -247,7 +250,7 @@ def stage_done(p: str) -> bool:
                 or (file_green(TIER_OPS) and file_green(TIER_REST)))
     if p == GQA:
         return file_green(p)
-    if p in (MICRO, MICRO_GQA, MICRO_LM):
+    if p in (MICRO, MICRO_GQA, MICRO_LM, MICRO_WIN):
         return micro_complete(p)
     return os.path.exists(p)
 
@@ -257,23 +260,27 @@ def main() -> None:
     start = time.time()
     log(f"watcher up, stamp={STAMP}, budget={MAX_SECONDS / 3600:.1f}h")
     while time.time() - start < MAX_SECONDS:
-        pending = [p for p in (MICRO, MICRO_GQA, MICRO_LM, BENCH, GQA, TIER)
+        pending = [p for p in (MICRO, MICRO_GQA, MICRO_LM, MICRO_WIN,
+                               BENCH, GQA, TIER)
                    if not stage_done(p)]
         if not pending:
             log("ALL_DONE: every artifact recorded")
             return
         if probe():
             log(f"tunnel LIVE; pending: {[os.path.basename(p) for p in pending]}")
-            # micros first: they fit in windows nothing else can use, and
-            # together (flash perf, GQA-compiled numerics+perf, LM
-            # tokens/sec+MFU) they cover the three on-chip asks even if
-            # no window ever fits the bench.
+            # micros first: they fit in windows nothing else can use,
+            # and together (flash perf, GQA-compiled numerics+perf, LM
+            # tokens/sec+MFU, banded window/sink kernels) they cover the
+            # on-chip evidence set even if no window ever fits the bench.
             if not stage_done(MICRO):
                 do_micro("build/micro_tpu_probe.py", MICRO, "micro")
             if not stage_done(MICRO_GQA) and probe():
                 do_micro("build/micro_gqa_probe.py", MICRO_GQA, "micro-gqa")
             if not stage_done(MICRO_LM) and probe():
                 do_micro("build/micro_lm_probe.py", MICRO_LM, "micro-lm")
+            if not stage_done(MICRO_WIN) and probe():
+                do_micro("build/micro_window_probe.py", MICRO_WIN,
+                         "micro-window")
             if not stage_done(BENCH) and probe():
                 do_bench()
             if not stage_done(GQA) and probe():

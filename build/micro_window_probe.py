@@ -1,0 +1,114 @@
+"""On-chip sliding-window/sink kernel evidence sized for a short live window.
+
+Times compiled fwd+bwd flash attention at one long sequence in three arms —
+full causal, windowed (banded grid), windowed+sink (prefix+band grid) — so
+one ~2-minute tunnel window yields the banded kernels' on-chip speedup
+factor and a compiled-correctness check against the f32 reference.
+Emitted incrementally like the sibling micro probes (build/micro_tpu_probe
+.py): a window dying mid-run keeps the earlier arms.
+
+Usage: python build/micro_window_probe.py [out.json]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = sys.argv[1] if len(sys.argv) > 1 else "artifacts/micro_window.json"
+
+
+def emit(doc):
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, OUT)
+
+
+def main():
+    t0 = time.time()
+    from tf_operator_tpu.workloads.runner import apply_forced_platform
+
+    apply_forced_platform()
+    import jax
+    import jax.numpy as jnp
+
+    from tf_operator_tpu.ops.attention import (
+        _on_tpu, flash_attention, xla_attention,
+    )
+
+    b, h, t, d = 1, 8, 4096, 64
+    w, s = 512, 4
+    doc = {
+        "platform": jax.devices()[0].platform,
+        "devices": len(jax.devices()),
+        "on_tpu": _on_tpu(),
+        "shape": {"b": b, "h": h, "t": t, "d": d, "window": w, "sink": s},
+        "connect_sec": round(time.time() - t0, 1),
+    }
+    emit(doc)
+    if not doc["on_tpu"]:
+        doc["note"] = "not on TPU; banded-kernel evidence needs the chip"
+        emit(doc)
+        print(json.dumps(doc))
+        return
+
+    keys = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(keys[0], (b, h, t, d)).astype(jnp.bfloat16)
+    k = jax.random.normal(keys[1], (b, h, t, d)).astype(jnp.bfloat16)
+    v = jax.random.normal(keys[2], (b, h, t, d)).astype(jnp.bfloat16)
+
+    def timed(fn, reps=3):
+        grad = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32)),
+            argnums=(0, 1, 2)))
+        c0 = time.time()
+        out = grad(q, k, v)
+        jax.device_get([jnp.sum(x.astype(jnp.float32)) for x in out])
+        compile_sec = time.time() - c0
+        t1 = time.perf_counter()
+        for _ in range(reps):
+            out = grad(q, k, v)
+        jax.device_get([jnp.sum(x.astype(jnp.float32)) for x in out])
+        return (time.perf_counter() - t1) / reps * 1e3, compile_sec
+
+    # correctness first (one compiled forward vs the f32 reference at a
+    # truncated length — full t would OOM the O(T^2) reference check)
+    tc = 1024
+    out_c = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, True, window=w, sink=s))(
+            q[:, :, :tc], k[:, :, :tc], v[:, :, :tc])
+    ref_c = xla_attention(
+        q[:, :, :tc].astype(jnp.float32), k[:, :, :tc].astype(jnp.float32),
+        v[:, :, :tc].astype(jnp.float32), causal=True, window=w, sink=s)
+    err = float(jnp.max(jnp.abs(out_c.astype(jnp.float32) - ref_c)))
+    doc.update(compiled_fwd_max_err=round(err, 5),
+               compiled_fwd_ok=bool(err < 0.05), kernel_path="pallas")
+    emit(doc)
+
+    full_ms, full_compile = timed(
+        lambda q, k, v: flash_attention(q, k, v, True))
+    doc.update(flash_full_ms=round(full_ms, 3),
+               full_compile_sec=round(full_compile, 1))
+    emit(doc)
+
+    win_ms, win_compile = timed(
+        lambda q, k, v: flash_attention(q, k, v, True, window=w))
+    doc.update(flash_window_ms=round(win_ms, 3),
+               window_compile_sec=round(win_compile, 1),
+               window_speedup=round(full_ms / win_ms, 3))
+    emit(doc)
+
+    sink_ms, sink_compile = timed(
+        lambda q, k, v: flash_attention(q, k, v, True, window=w, sink=s))
+    doc.update(flash_sink_ms=round(sink_ms, 3),
+               sink_compile_sec=round(sink_compile, 1),
+               sink_speedup=round(full_ms / sink_ms, 3),
+               total_sec=round(time.time() - t0, 1))
+    emit(doc)
+    print(json.dumps(doc))
+
+
+if __name__ == "__main__":
+    main()

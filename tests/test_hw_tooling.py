@@ -39,6 +39,7 @@ def hw(tmp_path, monkeypatch):
         ("MICRO", "micro_flash_tst.json"),
         ("MICRO_GQA", "micro_gqa_tst.json"),
         ("MICRO_LM", "micro_lm_tst.json"),
+        ("MICRO_WIN", "micro_window_tst.json"),
     ):
         setattr(mod, name, str(tmp_path / fname))
     return mod
@@ -145,15 +146,15 @@ class TestStageDone:
 
     def test_micro_stages_routed_to_micro_complete(self, hw, tmp_path):
         for fname in ("micro_flash_tst.json", "micro_gqa_tst.json",
-                      "micro_lm_tst.json"):
+                      "micro_lm_tst.json", "micro_window_tst.json"):
             (tmp_path / fname).write_text(json.dumps(
                 {"on_tpu": True, "total_sec": 9.0}))
-        for p in (hw.MICRO, hw.MICRO_GQA, hw.MICRO_LM):
+        for p in (hw.MICRO, hw.MICRO_GQA, hw.MICRO_LM, hw.MICRO_WIN):
             assert hw.stage_done(p)
 
     def test_absent_artifacts_pending(self, hw):
         for p in (hw.BENCH, hw.GQA, hw.TIER, hw.MICRO, hw.MICRO_GQA,
-                  hw.MICRO_LM):
+                  hw.MICRO_LM, hw.MICRO_WIN):
             assert not hw.stage_done(p)
 
 
