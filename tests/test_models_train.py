@@ -617,6 +617,88 @@ def test_remat_matches_plain_forward_and_trains():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+class TestChunkedCrossEntropy:
+    """Chunked weight-tied LM loss (train/step.chunked_softmax_xent): the
+    scan-with-remat CE must equal the full-logits loss in value AND grads —
+    it bounds peak logits memory to one chunk, it must not change the
+    math."""
+
+    def _setup(self, dtype=jnp.float32):
+        from tf_operator_tpu.train.step import lm_loss_fn
+
+        cfg = TransformerConfig(
+            vocab_size=256, num_layers=2, num_heads=2, d_model=32,
+            d_ff=64, max_len=33, dtype=dtype)
+        model = TransformerLM(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 33), 0, 256)
+        params = model.init(jax.random.PRNGKey(1), tokens[:, :-1])["params"]
+        return model, params, {"tokens": tokens}, lm_loss_fn
+
+    @pytest.mark.parametrize("chunk", [8, 13, 32, 100])
+    def test_matches_full_loss_and_grads(self, chunk):
+        model, params, batch, lm_loss_fn = self._setup()
+        full = lm_loss_fn(model.apply)
+        chunked = lm_loss_fn(model.apply, loss_chunk=chunk)
+        lf, _ = full(params, batch)
+        lc, _ = chunked(params, batch)
+        np.testing.assert_allclose(float(lf), float(lc), atol=2e-5)
+        gf = jax.grad(lambda p: full(p, batch)[0])(params)
+        gc = jax.grad(lambda p: chunked(p, batch)[0])(params)
+        for a, b in zip(jax.tree_util.tree_leaves(gf),
+                        jax.tree_util.tree_leaves(gc)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4)
+
+    def test_bf16_model_matches(self):
+        model, params, batch, lm_loss_fn = self._setup(jnp.bfloat16)
+        full = lm_loss_fn(model.apply)
+        chunked = lm_loss_fn(model.apply, loss_chunk=8)
+        lf, _ = full(params, batch)
+        lc, _ = chunked(params, batch)
+        np.testing.assert_allclose(float(lf), float(lc), atol=2e-5)
+        gf = jax.grad(lambda p: full(p, batch)[0])(params)
+        gc = jax.grad(lambda p: chunked(p, batch)[0])(params)
+        for a, b in zip(jax.tree_util.tree_leaves(gf),
+                        jax.tree_util.tree_leaves(gc)):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=2e-4)
+
+    def test_untied_layout_raises(self):
+        from tf_operator_tpu.train.step import lm_loss_fn
+
+        model, params, batch, _ = self._setup()
+        bad_params = {"other": params["wte"]}
+        chunked = lm_loss_fn(
+            lambda v, *a, **k: model.apply(
+                {"params": params}, *a, **k), loss_chunk=8)
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="table_fn"):
+            chunked(bad_params, batch)
+
+    def test_trains_under_jit(self):
+        from tf_operator_tpu.train.state import create_train_state
+        from tf_operator_tpu.train.step import lm_loss_fn, make_train_step
+        import optax
+
+        model, params, batch, _ = self._setup()
+        tx = optax.sgd(0.1)
+        state = create_train_state(
+            jax.random.PRNGKey(0), model, tx, batch["tokens"][:, :-1])
+        step = make_train_step(lm_loss_fn(model.apply, loss_chunk=8))
+        state, metrics = step(state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+
+    def test_invalid_chunk_rejected(self):
+        from tf_operator_tpu.train.step import chunked_softmax_xent
+
+        h = jnp.zeros((1, 4, 8))
+        w = jnp.zeros((16, 8))
+        y = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="positive"):
+            chunked_softmax_xent(h, w, y, 0)
+
+
 class TestGradAccumulation:
     """grad_accum=N microbatching: same optimizer math as one big batch
     (mean-reduced loss => mean of microbatch grads == full-batch grad)."""

@@ -443,7 +443,7 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
+    def __call__(self, tokens, return_hidden: bool = False):
         cfg = self.cfg
         b, t = tokens.shape
         emb = nn.Embed(cfg.vocab_size, cfg.d_model, name="wte",
@@ -477,6 +477,14 @@ class TransformerLM(nn.Module):
             # T x vocab readout for the rest of a prefill chunk
             x = x[:, -1:, :]
         x = _norm(cfg, "ln_f")(x)
+        if return_hidden:
+            # Pre-readout hidden states for the chunked cross-entropy path
+            # (train/step.chunked_softmax_xent): the caller computes the
+            # weight-tied readout per T-chunk against params['wte'] so the
+            # full [B, T, vocab] logits never materialize.  Cast to the
+            # model dtype exactly as the full readout does, so chunked and
+            # full losses see identical rounding.
+            return x.astype(cfg.dtype)
         # Weight-tied readout keeps the big vocab matmul on the MXU in bf16.
         logits = emb.attend(x.astype(cfg.dtype))
         return logits.astype(jnp.float32)

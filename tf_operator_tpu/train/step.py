@@ -14,6 +14,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -29,27 +30,108 @@ def softmax_cross_entropy(logits, labels) -> jax.Array:
     return -jnp.mean(ll)
 
 
-def lm_loss_fn(apply_fn, moe_aux_weight: float = 0.0):
+def chunked_softmax_xent(hidden, table, targets, chunk: int) -> jax.Array:
+    """Weight-tied LM cross-entropy computed in T-chunks so the full
+    [B, T, vocab] logits never materialize — at vocab 32k and t 2048 the
+    f32 logits alone are ~1 GB of HBM per example batch, usually the peak
+    of LM training memory.  Each chunk's logits are built inside a
+    rematerialized scan body: the forward keeps only the running scalar,
+    and the backward recomputes one chunk's logits at a time, so peak
+    logits memory is B * chunk * vocab regardless of T.
+
+    `hidden` [B, T, D] is the model's pre-readout activations, already
+    cast to the model dtype (TransformerLM(..., return_hidden=True)
+    applies the same rounding the full readout does, so chunked and full
+    losses agree to numerical noise); `table` [vocab, D] is the readout
+    matrix."""
+    if chunk <= 0:
+        raise ValueError(f"chunk must be positive, got {chunk}")
+    b, t, d = hidden.shape
+    n = -(-t // chunk)
+    pad = n * chunk - t
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    mask = (jnp.arange(n * chunk) < t)[None, :]
+    hc = hidden.reshape(b, n, chunk, d).transpose(1, 0, 2, 3)
+    yc = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+    mc = jnp.broadcast_to(mask, (b, n * chunk)).reshape(
+        b, n, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_nll(hx, yy, mm):
+        logits = jnp.einsum(
+            "bcd,vd->bcv", hx, table, preferred_element_type=jnp.float32
+        ).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, yy[..., None], axis=-1)[..., 0]
+        return jnp.sum(jnp.where(mm, -ll, 0.0))
+
+    def body(acc, args):
+        return acc + chunk_nll(*args), None
+
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (hc, yc, mc))
+    return total / (b * t)
+
+
+def _tied_table(params):
+    """Default readout-table accessor for the chunked loss: TransformerLM's
+    weight-tied embedding.  Raising here (rather than risking a silent
+    wrong-matrix lookup) is the contract for models with a different
+    layout — they pass their own accessor."""
+    try:
+        return params["wte"]["embedding"]
+    except KeyError as exc:
+        raise ValueError(
+            "loss_chunk needs the model's readout table; the default "
+            "accessor expects TransformerLM's tied "
+            "params['wte']['embedding'] — pass table_fn= for other "
+            "layouts") from exc
+
+
+def lm_loss_fn(apply_fn, moe_aux_weight: float = 0.0, loss_chunk: int = 0,
+               table_fn: Optional[Callable] = None):
     """Next-token prediction loss for TransformerLM.
 
     With moe_aux_weight > 0, the Switch-style load-balancing losses sown by
     MoE blocks (parallel/moe.py) are collected via the intermediates
     collection and added to the objective — without this the router gets no
-    balancing gradient and experts collapse."""
+    balancing gradient and experts collapse.
+
+    With loss_chunk > 0 the cross-entropy is computed via
+    chunked_softmax_xent (pre-readout hidden states + readout table),
+    holding peak logits memory to B * loss_chunk * vocab instead of the
+    full sequence.  The model must support `return_hidden=True` with a
+    weight-tied readout; `table_fn(params)` overrides the default
+    TransformerLM table accessor for other param layouts."""
+    get_table = table_fn or _tied_table
+
+    def unwrap(out):
+        return out if isinstance(out, tuple) else (out, None)
+
+    def ce(params, tokens, **apply_kwargs):
+        if loss_chunk > 0:
+            hidden, state = unwrap(apply_fn(
+                {"params": params}, tokens[:, :-1], return_hidden=True,
+                **apply_kwargs))
+            # hidden arrives already cast to the model dtype (the same
+            # rounding the full readout applies before the tied matmul)
+            return chunked_softmax_xent(
+                hidden, get_table(params), tokens[:, 1:], loss_chunk), state
+        logits, state = unwrap(apply_fn(
+            {"params": params}, tokens[:, :-1], **apply_kwargs))
+        return softmax_cross_entropy(logits, tokens[:, 1:]), state
 
     def loss(params, batch, rngs=None):
         tokens = batch["tokens"]
         if moe_aux_weight > 0.0:
             from ..parallel.moe import moe_aux_loss
 
-            logits, state = apply_fn(
-                {"params": params}, tokens[:, :-1], mutable=["intermediates"]
-            )
+            ce_val, state = ce(params, tokens, mutable=["intermediates"])
             aux = moe_aux_loss(state["intermediates"])
-            ce = softmax_cross_entropy(logits, tokens[:, 1:])
-            return ce + moe_aux_weight * aux, {"moe_aux_loss": aux}
-        logits = apply_fn({"params": params}, tokens[:, :-1])
-        return softmax_cross_entropy(logits, tokens[:, 1:]), {}
+            return ce_val + moe_aux_weight * aux, {"moe_aux_loss": aux}
+        ce_val, _ = ce(params, tokens)
+        return ce_val, {}
 
     return loss
 

@@ -59,6 +59,10 @@ def main(argv=None) -> int:
                         help="attention sinks (StreamingLLM): with "
                              "--attn-window, keep the first N positions "
                              "visible to every token")
+    parser.add_argument("--loss-chunk", type=int, default=0,
+                        help="compute the cross-entropy in T-chunks of "
+                             "this size so the full [B,T,vocab] logits "
+                             "never materialize (0 = one-shot)")
     parser.add_argument("--sample-tokens", type=int, default=0,
                         help="after training, greedily generate this many "
                              "tokens with the KV-cache decode path")
@@ -190,6 +194,7 @@ def main(argv=None) -> int:
     step = make_train_step(lm_loss_fn(
         model.apply,
         moe_aux_weight=args.moe_aux_weight if args.moe_experts else 0.0,
+        loss_chunk=args.loss_chunk,
     ), grad_accum=args.grad_accum)
     data = prefetch_to_device(
         synthetic_tokens(args.batch, args.seq_len + 1, args.vocab), mesh)
