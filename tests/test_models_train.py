@@ -665,16 +665,18 @@ class TestChunkedCrossEntropy:
                 atol=2e-4)
 
     def test_untied_layout_raises(self):
-        from tf_operator_tpu.train.step import lm_loss_fn
-
-        model, params, batch, _ = self._setup()
+        model, params, batch, lm_loss_fn = self._setup()
         bad_params = {"other": params["wte"]}
         chunked = lm_loss_fn(
             lambda v, *a, **k: model.apply(
                 {"params": params}, *a, **k), loss_chunk=8)
-        import pytest as _pytest
-        with _pytest.raises(ValueError, match="table_fn"):
+        with pytest.raises(ValueError, match="table_fn"):
             chunked(bad_params, batch)
+
+    def test_negative_loss_chunk_rejected(self):
+        model, params, batch, lm_loss_fn = self._setup()
+        with pytest.raises(ValueError, match="loss_chunk"):
+            lm_loss_fn(model.apply, loss_chunk=-8)
 
     def test_trains_under_jit(self):
         from tf_operator_tpu.train.state import create_train_state

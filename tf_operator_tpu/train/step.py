@@ -62,7 +62,7 @@ def chunked_softmax_xent(hidden, table, targets, chunk: int) -> jax.Array:
     def chunk_nll(hx, yy, mm):
         logits = jnp.einsum(
             "bcd,vd->bcv", hx, table, preferred_element_type=jnp.float32
-        ).astype(jnp.float32)
+        )
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, yy[..., None], axis=-1)[..., 0]
         return jnp.sum(jnp.where(mm, -ll, 0.0))
@@ -104,6 +104,11 @@ def lm_loss_fn(apply_fn, moe_aux_weight: float = 0.0, loss_chunk: int = 0,
     full sequence.  The model must support `return_hidden=True` with a
     weight-tied readout; `table_fn(params)` overrides the default
     TransformerLM table accessor for other param layouts."""
+    if loss_chunk < 0:
+        raise ValueError(
+            f"loss_chunk must be >= 0, got {loss_chunk} (0 disables "
+            "chunking; a negative value silently ignored would leave the "
+            "full-logits memory peak in place)")
     get_table = table_fn or _tied_table
 
     def unwrap(out):
