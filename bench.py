@@ -522,7 +522,14 @@ def child_throughput() -> None:
         batch = {"tokens": tokens}
         example = tokens[:2, :-1]
         state = create_train_state(jax.random.PRNGKey(0), model, tx, example)
-        fw_raw = make_train_step(lm_loss_fn(model.apply), jit=False)
+        # BENCH_LM_LOSS_CHUNK > 0 prices the chunked cross-entropy against
+        # the same bare full-logits baseline (identical math, bounded
+        # logits memory); default 0 keeps the headline metric comparable
+        # across rounds.
+        fw_raw = make_train_step(lm_loss_fn(
+            model.apply,
+            loss_chunk=int(os.environ.get("BENCH_LM_LOSS_CHUNK", "0")),
+        ), jit=False)
 
         # Bare baseline: hand-written step, same math, and — the kernel bar
         # (VERDICT #3) — the O(T²) XLA attention instead of the flash kernel.
@@ -550,6 +557,11 @@ def child_throughput() -> None:
         bare_state = (params, opt_state)
         unit, per_step = "tokens/sec", batch_size * seq
         tag = "llama_" if arch else ""
+        # a chunked-CE run is a different measurement; tag it so rounds
+        # can't silently mix chunked and full-logits throughput
+        chunk_env = int(os.environ.get("BENCH_LM_LOSS_CHUNK", "0"))
+        if chunk_env:
+            tag += f"losschunk{chunk_env}_"
         metric = f"lm_{tag}train_tokens_per_sec_bf16_b{batch_size}_t{seq}"
 
         # Training FLOPs/token ~= 6P (dense matmuls fwd+bwd) + causal
