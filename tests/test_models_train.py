@@ -617,6 +617,77 @@ def test_remat_matches_plain_forward_and_trains():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+class TestRopeScaling:
+    """RoPE context extension: linear position interpolation and NTK-aware
+    theta stretch."""
+
+    def test_linear_equals_scaled_positions(self):
+        from tf_operator_tpu.models.transformer import rope
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (1, 2, 16, 8))
+        a = rope(x, scaling="linear", factor=4.0)
+        b = rope(x, positions=jnp.arange(16) / 4.0)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_factor_one_linear_is_identity_scaling(self):
+        from tf_operator_tpu.models.transformer import rope
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 16, 8))
+        np.testing.assert_allclose(
+            np.asarray(rope(x, scaling="linear", factor=1.0)),
+            np.asarray(rope(x)), atol=1e-6)
+
+    def test_ntk_stretches_theta(self):
+        from tf_operator_tpu.models.transformer import rope
+
+        x = jax.random.normal(jax.random.PRNGKey(2), (1, 2, 16, 8))
+        d = 8
+        stretched = rope(x, theta=10000.0 * 4.0 ** (d / (d - 2)))
+        np.testing.assert_allclose(
+            np.asarray(rope(x, scaling="ntk", factor=4.0)),
+            np.asarray(stretched), atol=1e-6)
+        assert not np.allclose(
+            np.asarray(rope(x, scaling="ntk", factor=4.0)),
+            np.asarray(rope(x)), atol=1e-3)
+
+    def test_config_validation(self):
+        import pytest as _p
+
+        base = dict(vocab_size=64, num_layers=1, num_heads=2, d_model=16,
+                    d_ff=32, max_len=16)
+        with _p.raises(ValueError, match="use_rope"):
+            TransformerConfig(**base, rope_scaling="linear")
+        with _p.raises(ValueError, match="rope_factor"):
+            TransformerConfig(**base, use_rope=True,
+                              rope_scaling="ntk", rope_factor=0.5)
+        with _p.raises(ValueError, match="rope_scaling"):
+            TransformerConfig(**base, use_rope=True, rope_scaling="yarn")
+
+    def test_decode_matches_full_forward_with_scaling(self):
+        """Generation consistency: the decode path (per-step absolute
+        positions) must apply the same scaled rotation as the full
+        forward."""
+        import dataclasses
+
+        from tf_operator_tpu.models.generate import generate
+
+        cfg = TransformerConfig(
+            vocab_size=64, num_layers=2, num_heads=4, d_model=32, d_ff=64,
+            max_len=32, dtype=jnp.float32, num_kv_heads=2, use_rope=True,
+            norm="rmsnorm", mlp="swiglu", rope_scaling="linear",
+            rope_factor=2.0)
+        model = TransformerLM(cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(0), (2, 5), 0, 64)
+        params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+        out = generate(cfg, params, prompt, max_new_tokens=6)
+        seq = prompt
+        for _ in range(6):
+            logits = model.apply({"params": params}, seq)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
 class TestChunkedCrossEntropy:
     """Chunked weight-tied LM loss (train/step.chunked_softmax_xent): the
     scan-with-remat CE must equal the full-logits loss in value AND grads —

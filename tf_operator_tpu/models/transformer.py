@@ -62,6 +62,12 @@ class TransformerConfig:
     num_kv_heads: int = 0          # 0 -> num_heads (plain MHA)
     use_rope: bool = False
     rope_theta: float = 10000.0
+    # Context extension for RoPE models: "linear" (position interpolation,
+    # positions / factor) or "ntk" (NTK-aware theta stretch) with the
+    # extension factor — lets a model trained at max_len run at
+    # factor * max_len positions.  Requires use_rope.
+    rope_scaling: str = "none"     # "none" | "linear" | "ntk"
+    rope_factor: float = 1.0
     norm: str = "layernorm"        # "layernorm" | "rmsnorm"
     mlp: str = "gelu"              # "gelu" | "swiglu"
     # BERT extras
@@ -107,6 +113,16 @@ class TransformerConfig:
                 f"rope needs an even head_dim; d_model {self.d_model} / "
                 f"num_heads {self.num_heads} = {self.d_model // self.num_heads}"
             )
+        if self.rope_scaling not in ("none", "linear", "ntk"):
+            raise ValueError(
+                f"rope_scaling must be 'none'|'linear'|'ntk', "
+                f"got {self.rope_scaling!r}")
+        if self.rope_scaling != "none":
+            if not self.use_rope:
+                raise ValueError("rope_scaling requires use_rope=True")
+            if self.rope_factor < 1.0:
+                raise ValueError(
+                    f"rope_factor must be >= 1, got {self.rope_factor}")
         if self.num_kv_heads < 0 or self.num_kv_heads > self.num_heads or (
             self.num_kv_heads and self.num_heads % self.num_kv_heads
         ):
@@ -145,14 +161,32 @@ class TransformerConfig:
                     "least one non-sink slot")
 
 
-def rope(x, *, theta: float = 10000.0, positions=None):
+def rope(x, *, theta: float = 10000.0, positions=None,
+         scaling: str = "none", factor: float = 1.0):
     """Rotary position embeddings on [B, H, T, D] (D even): rotate feature
     pairs by position-dependent angles — relative positions enter attention
     scores directly, so no learned positional table is needed and sequences
-    extrapolate past the training length."""
+    extrapolate past the training length.
+
+    Context extension beyond graceful extrapolation:
+      scaling="linear" (position interpolation): positions are divided by
+        `factor`, squeezing an f-times longer sequence into the trained
+        angle range.
+      scaling="ntk" (NTK-aware): the base theta is stretched to
+        theta * factor**(d/(d-2)), slowing the high-frequency pairs less
+        than linear interpolation does — better short-range fidelity at
+        the same extension factor.
+    """
     b, h, t, d = x.shape
     if positions is None:
         positions = jnp.arange(t)
+    if scaling == "linear":
+        positions = positions / factor
+    elif scaling == "ntk":
+        theta = theta * factor ** (d / max(d - 2, 1))
+    elif scaling != "none":
+        raise ValueError(
+            f"rope scaling must be 'none'|'linear'|'ntk', got {scaling!r}")
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
     angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T, D/2]
     cos = jnp.cos(angles)[None, None]
@@ -203,8 +237,10 @@ class SelfAttention(nn.Module):
             out = self._decode_attend(q, k, v)
         else:
             if cfg.use_rope:
-                q = rope(q, theta=cfg.rope_theta)
-                k = rope(k, theta=cfg.rope_theta)
+                q = rope(q, theta=cfg.rope_theta,
+                         scaling=cfg.rope_scaling, factor=cfg.rope_factor)
+                k = rope(k, theta=cfg.rope_theta,
+                         scaling=cfg.rope_scaling, factor=cfg.rope_factor)
             # The flash and ring paths consume grouped k/v natively (no
             # repeat in HBM; ops/attention.py maps query heads to KV heads
             # in-kernel, and ring hops move the grouped blocks over ICI).
@@ -289,8 +325,10 @@ class SelfAttention(nn.Module):
         pos0 = cache_i.value
         if cfg.use_rope:
             positions = pos0 + jnp.arange(t)
-            q = rope(q, theta=cfg.rope_theta, positions=positions)
-            k = rope(k, theta=cfg.rope_theta, positions=positions)
+            q = rope(q, theta=cfg.rope_theta, positions=positions,
+                     scaling=cfg.rope_scaling, factor=cfg.rope_factor)
+            k = rope(k, theta=cfg.rope_theta, positions=positions,
+                     scaling=cfg.rope_scaling, factor=cfg.rope_factor)
 
         from ..ops.attention import repeat_kv
 

@@ -51,6 +51,13 @@ def main(argv=None) -> int:
                              "llama: RoPE + RMSNorm + SwiGLU + GQA")
     parser.add_argument("--kv-heads", type=int, default=0,
                         help="GQA KV heads for --arch llama (0 = heads/3)")
+    parser.add_argument("--rope-scaling", choices=("none", "linear", "ntk"),
+                        default="none",
+                        help="context extension for RoPE models: linear "
+                             "position interpolation or NTK-aware theta "
+                             "stretch (requires --arch llama)")
+    parser.add_argument("--rope-factor", type=float, default=1.0,
+                        help="extension factor for --rope-scaling")
     parser.add_argument("--attn-window", type=int, default=0,
                         help="sliding-window attention: each token attends "
                              "its last N positions (0 = full; kernel skips "
@@ -108,6 +115,12 @@ def main(argv=None) -> int:
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     extra = {}
     d_ff = args.d_model * 4
+    if args.arch != "llama" and args.rope_scaling != "none":
+        # explicit input is honored or rejected, never silently dropped:
+        # only the llama arch uses RoPE, so scaling has nothing to scale
+        print(f"--rope-scaling {args.rope_scaling} requires --arch llama "
+              "(the gpt arch uses learned positions, not RoPE)", flush=True)
+        return 2
     if args.arch == "llama":
         if args.kv_heads:
             kv = args.kv_heads
@@ -146,7 +159,8 @@ def main(argv=None) -> int:
                 # tp divides heads here, so kv=tp always satisfies both
                 kv = tp
         extra = dict(num_kv_heads=kv, use_rope=True, norm="rmsnorm",
-                     mlp="swiglu")
+                     mlp="swiglu", rope_scaling=args.rope_scaling,
+                     rope_factor=args.rope_factor)
         # SwiGLU has 3 matrices; 8/3 scaling keeps MLP params comparable
         # to the 2-matrix GELU MLP at 4*d_model
         d_ff = args.d_model * 8 // 3
