@@ -351,6 +351,48 @@ class TestGenerate:
         shapes = {tuple(x.shape) for x in jax.tree_util.tree_leaves(cache)}
         assert (2, 4, 9, 8) in shapes, shapes
 
+    @pytest.mark.parametrize("extra", [
+        {}, {"attn_window": 6, "attn_sink": 2}])
+    def test_int8_cache_matches_float_cache(self, extra):
+        """kv_cache_dtype='int8': half the cache memory; generation should
+        track the float cache closely (absmax row quantization keeps
+        relative error ~1/127)."""
+        import dataclasses
+
+        from tf_operator_tpu.models.generate import generate
+
+        cfg = dataclasses.replace(
+            self._cfg("gpt"), kv_cache_dtype="int8", **extra)
+        cfg_f = dataclasses.replace(self._cfg("gpt"), **extra)
+        model = TransformerLM(cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(0), (2, 5), 0, 64)
+        params = model.init(jax.random.PRNGKey(1), prompt)["params"]
+        out_q = generate(cfg, params, prompt, max_new_tokens=10)
+        out_f = generate(cfg_f, params, prompt, max_new_tokens=10)
+        agreement = float(np.mean(np.asarray(out_q) == np.asarray(out_f)))
+        assert agreement >= 0.9, agreement
+
+    def test_int8_cache_leaves(self):
+        """The cache really is int8 + f32 scales (half the K/V bytes)."""
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            self._cfg("gpt"), kv_cache_dtype="int8", decode=True)
+        model = TransformerLM(cfg)
+        cache = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32))["cache"]
+        dtypes = {str(x.dtype) for x in jax.tree_util.tree_leaves(cache)
+                  if x.ndim == 4}
+        assert dtypes == {"int8"}, dtypes
+        scales = [x for x in jax.tree_util.tree_leaves(cache) if x.ndim == 3]
+        assert len(scales) == 2 * cfg.num_layers
+
+    def test_bad_kv_cache_dtype_rejected(self):
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            TransformerConfig(
+                vocab_size=64, num_layers=1, num_heads=2, d_model=16,
+                d_ff=32, max_len=16, kv_cache_dtype="fp8")
+
     def test_chunked_prefill_with_window(self):
         """Two multi-token calls on the same rolling cache (chunked
         prefill) must see each other across the chunk boundary — the

@@ -42,6 +42,11 @@ def _cache_sharding(mesh, leaf_shape):
     if (len(leaf_shape) == 4 and "tp" in mesh.axis_names
             and leaf_shape[1] % mesh.shape["tp"] == 0):
         return NamedSharding(mesh, P(None, "tp", None, None))
+    if (len(leaf_shape) == 3 and "tp" in mesh.axis_names
+            and leaf_shape[1] % mesh.shape["tp"] == 0):
+        # int8-cache scale leaves [batch, kv_heads, slots] shard with
+        # their K/V tensors on the kv-head axis
+        return NamedSharding(mesh, P(None, "tp", None))
     return NamedSharding(mesh, P())
 
 

@@ -66,6 +66,11 @@ def main(argv=None) -> int:
                         help="attention sinks (StreamingLLM): with "
                              "--attn-window, keep the first N positions "
                              "visible to every token")
+    parser.add_argument("--kv-cache-dtype", choices=("model", "int8"),
+                        default="model",
+                        help="decode KV-cache storage for --sample-tokens: "
+                             "int8 halves cache memory/bandwidth (absmax "
+                             "row quantization)")
     parser.add_argument("--loss-chunk", type=int, default=0,
                         help="compute the cross-entropy in T-chunks of "
                              "this size so the full [B,T,vocab] logits "
@@ -171,7 +176,8 @@ def main(argv=None) -> int:
             d_ff=d_ff, max_len=args.seq_len,
             mesh=mesh, ring_axis="sp", seq_parallel=args.seq_parallel,
             remat=args.remat, moe_num_experts=args.moe_experts,
-            attn_window=args.attn_window, attn_sink=args.attn_sink, **extra,
+            attn_window=args.attn_window, attn_sink=args.attn_sink,
+            kv_cache_dtype=args.kv_cache_dtype, **extra,
         )
     except ValueError as e:
         # e.g. --arch llama with an odd derived head_dim: a CLI-input
