@@ -478,6 +478,21 @@ class TestGenerate:
         out = generate(cfg_mesh, sharded_params, prompt, max_new_tokens=6)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(baseline))
 
+        # int8 cache under the same mesh: the scale leaves [b, kvh, slots]
+        # must shard with their K/V tensors (generate._cache_sharding's
+        # 3-D rule) and the tokens still track the unsharded float run
+        from jax.sharding import PartitionSpec as P
+
+        from tf_operator_tpu.models.generate import _cache_sharding
+
+        assert _cache_sharding(mesh, (2, 2, 32)).spec == P(None, "tp", None)
+        cfg_q = dataclasses.replace(cfg, mesh=mesh, kv_cache_dtype="int8")
+        out_q = generate(cfg_q, sharded_params, prompt, max_new_tokens=6)
+        gen_q = np.asarray(out_q)[:, prompt.shape[1]:]
+        gen_f = np.asarray(baseline)[:, prompt.shape[1]:]
+        agreement = float(np.mean(gen_q == gen_f))
+        assert agreement >= 0.9, agreement
+
     def test_rejects_overlong_and_missing_rng(self):
         from tf_operator_tpu.models.generate import generate
 
