@@ -211,6 +211,17 @@ def test_lm_attn_window_plumbs_through_and_validates():
         TransformerConfig(**{**base, "causal": False}, attn_window=8)
 
 
+def greedy_reference(model, params, prompt, n):
+    """Naive generation oracle: re-run the full (uncached) forward every
+    token — shared by the KV-cache equivalence tests."""
+    seq = prompt
+    for _ in range(n):
+        logits = model.apply({"params": params}, seq)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    return seq
+
+
 class TestGenerate:
     """KV-cache decoding: the cached path must reproduce full-forward
     results token for token (prefill + T=1 steps vs O(T²) recompute)."""
@@ -237,12 +248,7 @@ class TestGenerate:
         np.testing.assert_array_equal(np.asarray(out[:, :5]),
                                       np.asarray(prompt))
 
-        # naive reference: re-run the full (uncached) forward every token
-        seq = prompt
-        for _ in range(6):
-            logits = model.apply({"params": params}, seq)
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        seq = greedy_reference(model, params, prompt, 6)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
     @pytest.mark.parametrize("arch", ["gpt", "llama"])
@@ -263,12 +269,7 @@ class TestGenerate:
         out = generate(cfg, params, prompt, max_new_tokens=12)
         assert out.shape == (2, 17)
 
-        # naive reference: full windowed (non-decode) forward every token
-        seq = prompt
-        for _ in range(12):
-            logits = model.apply({"params": params}, seq)
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        seq = greedy_reference(model, params, prompt, 12)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
     def test_rolling_cache_capacity_is_window(self):
@@ -297,11 +298,7 @@ class TestGenerate:
         prompt = jax.random.randint(jax.random.PRNGKey(5), (1, 9), 0, 64)
         params = model.init(jax.random.PRNGKey(1), prompt)["params"]
         out = generate(cfg, params, prompt, max_new_tokens=5)
-        seq = prompt
-        for _ in range(5):
-            logits = model.apply({"params": params}, seq)
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        seq = greedy_reference(model, params, prompt, 5)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
     @pytest.mark.parametrize("arch", ["gpt", "llama"])
@@ -322,11 +319,7 @@ class TestGenerate:
 
         out = generate(cfg, params, prompt, max_new_tokens=14)
         assert out.shape == (2, 19)
-        seq = prompt
-        for _ in range(14):
-            logits = model.apply({"params": params}, seq)
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        seq = greedy_reference(model, params, prompt, 14)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
         # the sink must actually change the distribution vs the pure
         # window once the earliest tokens roll out of range (greedy
@@ -737,11 +730,7 @@ class TestRopeScaling:
         prompt = jax.random.randint(jax.random.PRNGKey(0), (2, 5), 0, 64)
         params = model.init(jax.random.PRNGKey(1), prompt)["params"]
         out = generate(cfg, params, prompt, max_new_tokens=6)
-        seq = prompt
-        for _ in range(6):
-            logits = model.apply({"params": params}, seq)
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        seq = greedy_reference(model, params, prompt, 6)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
 
 

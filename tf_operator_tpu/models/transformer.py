@@ -356,6 +356,33 @@ class SelfAttention(nn.Module):
                 return stored
             return (stored.astype(jnp.float32)
                     * scale_var.value[..., None]).astype(cfg.dtype)
+
+        def append_and_read(start):
+            """Write this call's k/v span at `start` (encoded) and return
+            the full cache in model dtype for the attention compute, with
+            the in-hand span exact — the shared contract of the two
+            contiguous-write decode branches (windowed T=1 and
+            non-windowed); the chunked windowed prefill scatters instead."""
+            kq, ks = enc(k)
+            vq, vs = enc(v)
+            kf = lax.dynamic_update_slice(cache_k.value, kq, (0, 0, start, 0))
+            vf = lax.dynamic_update_slice(cache_v.value, vq, (0, 0, start, 0))
+            cache_k.value, cache_v.value = kf, vf
+            if quant:
+                cache_ks.value = lax.dynamic_update_slice(
+                    cache_ks.value, ks, (0, 0, start))
+                cache_vs.value = lax.dynamic_update_slice(
+                    cache_vs.value, vs, (0, 0, start))
+                kf = dec(kf, cache_ks)
+                vf = dec(vf, cache_vs)
+                # attend the in-hand exact k/v for the span just written;
+                # only previously cached positions pay the quantization
+                # round-trip
+                kf = lax.dynamic_update_slice(
+                    kf, k.astype(cfg.dtype), (0, 0, start, 0))
+                vf = lax.dynamic_update_slice(
+                    vf, v.astype(cfg.dtype), (0, 0, start, 0))
+            return kf, vf
         if window:
             # absolute position + 1 per slot; 0 = empty (so the zero-filled
             # fresh cache from generate._fresh_cache reads as empty)
@@ -433,27 +460,10 @@ class SelfAttention(nn.Module):
             # absolute position (empty slots p1=0 never pass k_abs >= 0).
             slot = jnp.where(pos0 < sink, pos0,
                              sink + (pos0 - sink) % (cap - sink))
-            kq, ks = enc(k)
-            vq, vs = enc(v)
-            kf = lax.dynamic_update_slice(cache_k.value, kq, (0, 0, slot, 0))
-            vf = lax.dynamic_update_slice(cache_v.value, vq, (0, 0, slot, 0))
+            kf, vf = append_and_read(slot)
             p1 = lax.dynamic_update_slice(
                 cache_p1.value, (pos0 + 1)[None].astype(jnp.int32), (slot,))
-            cache_k.value, cache_v.value, cache_p1.value = kf, vf, p1
-            if quant:
-                cache_ks.value = lax.dynamic_update_slice(
-                    cache_ks.value, ks, (0, 0, slot))
-                cache_vs.value = lax.dynamic_update_slice(
-                    cache_vs.value, vs, (0, 0, slot))
-                kf = dec(kf, cache_ks)
-                vf = dec(vf, cache_vs)
-                # attend the in-hand exact k/v for the slot just written —
-                # same noise-free-current-chunk contract as the windowed
-                # prefill branch
-                kf = lax.dynamic_update_slice(
-                    kf, k.astype(cfg.dtype), (0, 0, slot, 0))
-                vf = lax.dynamic_update_slice(
-                    vf, v.astype(cfg.dtype), (0, 0, slot, 0))
+            cache_p1.value = p1
             cache_i.value = pos0 + 1
             kf, vf = repeat_kv(q, kf, vf)
             logits = jnp.einsum(
@@ -468,25 +478,7 @@ class SelfAttention(nn.Module):
             probs = jax.nn.softmax(logits, axis=-1).astype(vf.dtype)
             return jnp.einsum("bhqk,bhkd->bhqd", probs, vf).astype(q.dtype)
 
-        kq, ks = enc(k)
-        vq, vs = enc(v)
-        kf = lax.dynamic_update_slice(cache_k.value, kq, (0, 0, pos0, 0))
-        vf = lax.dynamic_update_slice(cache_v.value, vq, (0, 0, pos0, 0))
-        cache_k.value, cache_v.value = kf, vf
-        if quant:
-            cache_ks.value = lax.dynamic_update_slice(
-                cache_ks.value, ks, (0, 0, pos0))
-            cache_vs.value = lax.dynamic_update_slice(
-                cache_vs.value, vs, (0, 0, pos0))
-            kf = dec(kf, cache_ks)
-            vf = dec(vf, cache_vs)
-            # attend the in-hand exact chunk (noise-free, matching the
-            # windowed prefill branch); only previously cached positions
-            # pay the quantization round-trip
-            kf = lax.dynamic_update_slice(
-                kf, k.astype(cfg.dtype), (0, 0, pos0, 0))
-            vf = lax.dynamic_update_slice(
-                vf, v.astype(cfg.dtype), (0, 0, pos0, 0))
+        kf, vf = append_and_read(pos0)
         cache_i.value = pos0 + t
 
         kf, vf = repeat_kv(q, kf, vf)

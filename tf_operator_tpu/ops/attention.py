@@ -184,6 +184,30 @@ def _band_kb(qi, ki, block_q: int, block_k: int, k_band: int):
     return ((qi + 1) * block_q - 1) // block_k - (k_band - 1) + ki
 
 
+def _recover_kb(qi, ki, block_q: int, block_k: int,
+                k_band: Optional[int], sink: int):
+    """Grid step -> true k-block index for the fwd/dq kernels: identity on
+    the full grid; under a band, sink-prefix steps map straight to the
+    first blocks and the rest to the diagonal band."""
+    if k_band is None:
+        return ki
+    sb = _sink_blocks(sink, block_k)
+    banded = _band_kb(qi, ki - sb, block_q, block_k, k_band)
+    return jnp.where(ki < sb, ki, banded) if sb else banded
+
+
+def _reduction_live(qi, kb, ki, block_q: int, block_k: int, causal: bool,
+                    window: Optional[int], k_band: Optional[int], sink: int):
+    """Shared fwd/dq compute-skip predicate: mask liveness for the true
+    block kb, plus — on a banded grid — skipping the pre-array overhang
+    and any block the sink prefix already processed (dedup)."""
+    live = _block_live(qi, kb, block_q, block_k, causal, window, sink)
+    if k_band is not None:
+        sb = _sink_blocks(sink, block_k)
+        live = jnp.logical_and(live, jnp.logical_or(ki < sb, kb >= sb))
+    return live
+
+
 def _kv_block_spec(block_q: int, block_k: int, head_dim: int, group: int,
                    k_band: Optional[int], sink: int = 0):
     """K/V BlockSpec for a (bh, q-block, k-step) grid — full reduction or
@@ -242,12 +266,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
     maybe_lse_ref, (m_scr, l_scr, acc_scr) = rest[:-3], rest[-3:]
     qi = pl.program_id(1)
     ki = pl.program_id(2)
-    if k_band is None:
-        kb = ki
-    else:
-        sb = _sink_blocks(sink, block_k)
-        banded = _band_kb(qi, ki - sb, block_q, block_k, k_band)
-        kb = jnp.where(ki < sb, ki, banded) if sb else banded
+    kb = _recover_kb(qi, ki, block_q, block_k, k_band, sink)
     head_dim = q_ref.shape[-1]
 
     @pl.when(ki == 0)
@@ -300,14 +319,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *rest, scale: float,
 
     if causal:
         # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
-        live = _block_live(qi, kb, block_q, block_k, causal, window, sink)
-        if k_band is not None:
-            # banded steps skip the pre-array overhang AND any block the
-            # sink prefix already processed (dedup); prefix steps pass.
-            sb = _sink_blocks(sink, block_k)
-            live = jnp.logical_and(
-                live, jnp.logical_or(ki < sb, kb >= sb))
-        pl.when(live)(_compute)
+        pl.when(_reduction_live(qi, kb, ki, block_q, block_k, causal,
+                                window, k_band, sink))(_compute)
     else:
         _compute()
 
@@ -413,12 +426,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     # the true k-block index is recovered from (qi, ki) as in _fwd_kernel.
     qi = pl.program_id(1)
     ki = pl.program_id(2)
-    if k_band is None:
-        kb = ki
-    else:
-        sb = _sink_blocks(sink, block_k)
-        banded = _band_kb(qi, ki - sb, block_q, block_k, k_band)
-        kb = jnp.where(ki < sb, ki, banded) if sb else banded
+    kb = _recover_kb(qi, ki, block_q, block_k, k_band, sink)
 
     @pl.when(ki == 0)
     def _init():
@@ -464,14 +472,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     if causal:
         # Dead blocks skip FLOPs; pipeline + init/write guards still advance.
-        live = _block_live(qi, kb, block_q, block_k, causal, window, sink)
-        if k_band is not None:
-            # banded steps skip the pre-array overhang AND any block the
-            # sink prefix already processed (dedup); prefix steps pass.
-            sb = _sink_blocks(sink, block_k)
-            live = jnp.logical_and(
-                live, jnp.logical_or(ki < sb, kb >= sb))
-        pl.when(live)(_compute)
+        pl.when(_reduction_live(qi, kb, ki, block_q, block_k, causal,
+                                window, k_band, sink))(_compute)
     else:
         _compute()
 
