@@ -230,6 +230,39 @@ def test_llama_training_job(local_stack):
     assert any("done" in t for t in logs.values())
 
 
+def test_longcontext_stack_training_job(local_stack):
+    """The full long-context/efficiency stack in one controller-launched
+    job: llama arch with NTK rope scaling, sliding-window attention with
+    sinks, chunked cross-entropy, and int8-cache sampling — proving the
+    knobs compose under the real control plane, not just in unit tests."""
+    cluster, controller, client, tmp = local_stack
+    job = TPUJob(
+        metadata=ObjectMeta(name="longctx-tiny"),
+        spec=TPUJobSpec(replica_specs={
+            ReplicaType.WORKER: ReplicaSpec(
+                replicas=1,
+                template=PodTemplateSpec(containers=[Container(
+                    name="tensorflow", image="local",
+                    command=[sys.executable, "-m", "tf_operator_tpu.workloads.lm"],
+                    args=["--arch", "llama", "--steps", "4", "--batch", "8",
+                          "--seq-len", "64", "--vocab", "128", "--layers", "1",
+                          "--d-model", "64",
+                          "--attn-window", "16", "--attn-sink", "4",
+                          "--rope-scaling", "ntk", "--rope-factor", "2",
+                          "--loss-chunk", "16",
+                          "--kv-cache-dtype", "int8", "--sample-tokens", "4"],
+                )]),
+            )
+        }),
+    )
+    client.create(job)
+    client.wait_for_job("longctx-tiny", timeout=240)
+    logs = client.get_logs("longctx-tiny")
+    assert client.is_job_succeeded("longctx-tiny"), logs
+    assert any("sample:" in t for t in logs.values()), logs
+    assert any("done" in t for t in logs.values())
+
+
 @pytest.mark.slow
 def test_multiprocess_jax_distributed_collective(local_stack):
     """Two controller-launched worker processes form a real jax.distributed

@@ -357,12 +357,14 @@ class SelfAttention(nn.Module):
             return (stored.astype(jnp.float32)
                     * scale_var.value[..., None]).astype(cfg.dtype)
 
-        def append_and_read(start):
-            """Write this call's k/v span at `start` (encoded) and return
-            the full cache in model dtype for the attention compute, with
-            the in-hand span exact — the shared contract of the two
-            contiguous-write decode branches (windowed T=1 and
-            non-windowed); the chunked windowed prefill scatters instead."""
+        def append_and_read(k, v, start):
+            """Write the (already position-rotated) k/v span at `start`
+            (encoded) and return the full cache in model dtype for the
+            attention compute, with the in-hand span exact — the shared
+            contract of the two contiguous-write decode branches (windowed
+            T=1 and non-windowed); the chunked windowed prefill scatters
+            instead.  k/v are explicit parameters so the helper cannot
+            silently capture pre-RoPE tensors."""
             kq, ks = enc(k)
             vq, vs = enc(v)
             kf = lax.dynamic_update_slice(cache_k.value, kq, (0, 0, start, 0))
@@ -460,7 +462,7 @@ class SelfAttention(nn.Module):
             # absolute position (empty slots p1=0 never pass k_abs >= 0).
             slot = jnp.where(pos0 < sink, pos0,
                              sink + (pos0 - sink) % (cap - sink))
-            kf, vf = append_and_read(slot)
+            kf, vf = append_and_read(k, v, slot)
             p1 = lax.dynamic_update_slice(
                 cache_p1.value, (pos0 + 1)[None].astype(jnp.int32), (slot,))
             cache_p1.value = p1
@@ -478,7 +480,7 @@ class SelfAttention(nn.Module):
             probs = jax.nn.softmax(logits, axis=-1).astype(vf.dtype)
             return jnp.einsum("bhqk,bhkd->bhqd", probs, vf).astype(q.dtype)
 
-        kf, vf = append_and_read(pos0)
+        kf, vf = append_and_read(k, v, pos0)
         cache_i.value = pos0 + t
 
         kf, vf = repeat_kv(q, kf, vf)
