@@ -94,7 +94,14 @@ def probe() -> bool:
 
 def bench_complete(path: str) -> bool:
     """A bench capture counts as done only if it ran on TPU, produced a
-    nonzero headline, and no stage was cut short by a tunnel wedge."""
+    nonzero headline, and no stage was cut short by a tunnel wedge.
+
+    Truncation is judged on the DOC-level partial flags (headline,
+    second-model, attention + its arms): bench.py marks partials on the
+    parsed result docs (`partial_rc`, bench.py:211,250), while its stage
+    entries record a timeout as rc=-9 — and a late rung can legitimately
+    complete after an earlier rung timed out, so stage rc alone can't
+    distinguish 'ladder recovered' from 'ladder truncated'."""
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -104,11 +111,21 @@ def bench_complete(path: str) -> bool:
     on_tpu = any(s.get("stage") == "probe" and s.get("ok")
                  and "tpu" in str(s.get("platform", "")).lower()
                  for s in stages)
-    partial = any(s.get("partial_rc") or s.get("rc") is None
-                  or s.get("skipped") for s in stages
+    skipped = any(s.get("skipped") for s in stages
                   if str(s.get("stage", "")).startswith(
                       ("throughput", "attention")))
-    return on_tpu and doc.get("value", 0) > 0 and not partial
+    partial = bool(doc.get("partial_rc") or doc.get("error"))
+    for sub in ("lm", "resnet"):
+        if isinstance(doc.get(sub), dict) and doc[sub].get("partial_rc"):
+            partial = True
+    att = doc.get("attention")
+    if not isinstance(att, dict):
+        partial = True  # ladder never produced rows at all
+    else:
+        for arm in (att, att.get("gqa_arm"), att.get("window_arm")):
+            if isinstance(arm, dict) and arm.get("partial_rc"):
+                partial = True
+    return on_tpu and doc.get("value", 0) > 0 and not (partial or skipped)
 
 
 def next_partial(dst: str) -> str:

@@ -134,6 +134,77 @@ def test_attention_gqa_arm_env(monkeypatch):
     assert envs[2]["BENCH_ATTN_SEQS"] == "4096,8192"
 
 
+def test_compact_summary_fits_and_keeps_contract(monkeypatch, tmp_path):
+    """BENCH_r04 came back parsed:null because the full doc outgrew the
+    driver's tail capture.  The final stdout line must stay compact (full
+    doc relegated to artifacts/) while keeping every field the watcher's
+    bench_complete() reads: probe platform/ok, partial flags, value."""
+    monkeypatch.setattr(bench, "REPO", str(tmp_path))
+    fat_err = "x" * 5000
+    headline = {
+        "metric": "lm_train_throughput", "value": 123.4,
+        "unit": "tokens/sec", "vs_baseline": 1.01, "platform": "tpu",
+        "mfu": 0.41,
+        "resnet": {"metric": "resnet_train_throughput", "value": 2000.0,
+                   "unit": "images/sec", "vs_baseline": 0.99,
+                   "platform": "tpu", "huge_debug": fat_err},
+        "attention": {
+            "kernel_path": "pallas", "shape": {"b": 4, "h": 12, "d": 64},
+            "fwd_bwd": [{"seq": 4096, "flash_ms": 1.0, "xla_ms": 2.0,
+                         "speedup": 2.0, "xla_error": fat_err}],
+            "partial_rc": -9, "partial": "ladder truncated by child exit",
+            "gqa_arm": {"kernel_path": "pallas", "shape": {},
+                        "fwd_bwd": [{"seq": 1024, "kv_heads": 4,
+                                     "speedup": 1.3}]},
+        },
+        "native": {"speedup": 1.8, "rows": [{"big": fat_err}]},
+        "control_plane": {
+            "kind": "skipped: no docker/kind binary in bench environment",
+            "local": {"time_to_all_running_sec": 1.2,
+                      "jobs": [{"detail": fat_err}]},
+        },
+        "stages": [
+            {"stage": "probe", "attempt": 0, "ok": True, "platform": "tpu",
+             "devices": 1, "sec": 12.0},
+            {"stage": "throughput:lm", "batch": 8, "rc": 0, "ok": True,
+             "sec": 100.0, "err": fat_err},
+            {"stage": "attention", "rc": -9, "ok": True, "sec": 400.0},
+        ],
+    }
+    monkeypatch.setattr(bench, "MODEL", "lm")
+    compact = bench._compact_summary(headline)
+    line = json.dumps(compact)
+    assert len(line) < 8000, f"compact line still too big: {len(line)}"
+    # watcher contract: probe platform + doc-level partial flags + value
+    probe = next(s for s in compact["stages"] if s["stage"] == "probe")
+    assert probe["ok"] and probe["platform"] == "tpu"
+    assert compact["attention"]["partial_rc"] == -9
+    assert compact["value"] == 123.4 and compact["mfu"] == 0.41
+    assert compact["attention"]["fwd_bwd"][0]["speedup"] == 2.0
+    assert len(compact["attention"]["fwd_bwd"][0]["xla_error"]) <= 60
+    assert compact["resnet"]["vs_baseline"] == 0.99
+    assert "rows" not in compact["native"]
+    assert compact["control_plane"]["kind"].startswith("skipped")
+    assert compact["control_plane"]["local"] == {
+        "time_to_all_running_sec": 1.2}
+    # the watcher must reject this capture: the attention ladder is partial
+    import importlib.util as ilu
+    spec = ilu.spec_from_file_location("hw", REPO / "build" / "hw_watcher.py")
+    hw = ilu.module_from_spec(spec)
+    spec.loader.exec_module(hw)
+    cap = tmp_path / "cap.json"
+    cap.write_text(line)
+    assert not hw.bench_complete(str(cap))
+    # and accept it once the ladder completes
+    del headline["attention"]["partial_rc"], headline["attention"]["partial"]
+    cap.write_text(json.dumps(bench._compact_summary(headline)))
+    assert hw.bench_complete(str(cap))
+    # the full document survives untruncated on disk
+    with open(tmp_path / compact["full_doc"]) as f:
+        full = json.load(f)
+    assert full["resnet"]["huge_debug"] == fat_err
+
+
 def test_cpu_fallback_single_rung(monkeypatch):
     """platform None: fixed small-shape env, exactly one rung."""
     complete = _json({"metric": "m", "value": 3.0, "unit": "u",

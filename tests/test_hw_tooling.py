@@ -102,30 +102,74 @@ class TestMicroComplete:
 
 
 class TestBenchComplete:
+    """Pinned against the REAL compact-line shapes bench.py emits:
+    partial flags live on the result docs (bench.py:211,250 set
+    `partial_rc` on the parsed child doc, never on stage entries), and a
+    timed-out stage records rc=-9 — which alone must NOT reject a run,
+    because a later ladder rung can complete after an earlier timeout."""
+
     @staticmethod
-    def doc(on_tpu=True, partial=False, value=100.0):
+    def doc(on_tpu=True, value=100.0, attention=True, **overrides):
         probe = ({"stage": "probe", "ok": True, "platform": "tpu"}
                  if on_tpu else
                  {"stage": "probe", "ok": False, "err": "timeout"})
-        thr = {"stage": "throughput:lm", "rc": 0, "ok": True}
-        if partial:
-            thr["partial_rc"] = -9
-        return {"value": value, "stages": [probe, thr]}
+        doc = {"metric": "lm_train_throughput", "value": value,
+               "unit": "tokens/sec", "vs_baseline": 1.0,
+               "resnet": {"value": 2000.0, "vs_baseline": 0.99},
+               "stages": [probe,
+                          {"stage": "throughput:lm", "rc": 0, "ok": True}]}
+        if attention:
+            doc["attention"] = {
+                "kernel_path": "pallas",
+                "fwd_bwd": [{"seq": 4096, "speedup": 1.3}],
+                "gqa_arm": {"kernel_path": "pallas", "fwd_bwd": []},
+            }
+        doc.update(overrides)
+        return doc
+
+    def write(self, tmp_path, doc):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps(doc))
+        return str(p)
 
     def test_complete_tpu_run(self, hw, tmp_path):
-        p = tmp_path / "b.json"
-        p.write_text(json.dumps(self.doc()))
-        assert hw.bench_complete(str(p))
+        assert hw.bench_complete(self.write(tmp_path, self.doc()))
 
     def test_cpu_fallback_rejected(self, hw, tmp_path):
-        p = tmp_path / "b.json"
-        p.write_text(json.dumps(self.doc(on_tpu=False)))
-        assert not hw.bench_complete(str(p))
+        assert not hw.bench_complete(
+            self.write(tmp_path, self.doc(on_tpu=False)))
 
-    def test_partial_stage_rejected(self, hw, tmp_path):
-        p = tmp_path / "b.json"
-        p.write_text(json.dumps(self.doc(partial=True)))
-        assert not hw.bench_complete(str(p))
+    def test_headline_partial_rejected(self, hw, tmp_path):
+        assert not hw.bench_complete(
+            self.write(tmp_path, self.doc(partial_rc=-9)))
+
+    def test_second_model_partial_rejected(self, hw, tmp_path):
+        doc = self.doc()
+        doc["resnet"]["partial_rc"] = -9
+        assert not hw.bench_complete(self.write(tmp_path, doc))
+
+    def test_attention_arm_partial_rejected(self, hw, tmp_path):
+        doc = self.doc()
+        doc["attention"]["gqa_arm"]["partial_rc"] = -9
+        assert not hw.bench_complete(self.write(tmp_path, doc))
+
+    def test_missing_attention_rejected(self, hw, tmp_path):
+        assert not hw.bench_complete(
+            self.write(tmp_path, self.doc(attention=False)))
+
+    def test_skipped_stage_rejected(self, hw, tmp_path):
+        doc = self.doc()
+        doc["stages"].append({"stage": "throughput:resnet",
+                              "skipped": "backend unreachable"})
+        assert not hw.bench_complete(self.write(tmp_path, doc))
+
+    def test_recovered_ladder_timeout_still_complete(self, hw, tmp_path):
+        # batch-128 rung timed out (rc=-9) but batch-32 completed: the
+        # result docs carry no partial flag, so the capture is complete.
+        doc = self.doc()
+        doc["stages"].insert(1, {"stage": "throughput:lm", "batch": 128,
+                                 "rc": -9, "ok": True})
+        assert hw.bench_complete(self.write(tmp_path, doc))
 
 
 class TestStageDone:
