@@ -1022,8 +1022,15 @@ def child_k8s_control_plane() -> None:
     from tf_operator_tpu.runtime.k8s import KubeConfig
     from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
 
+    # Unthrottled by default: this child MEASURES control-plane speed, and
+    # the client-side QPS limiter (server --qps/--burst, default 5/10)
+    # would dominate the number.  BENCH_K8S_QPS opts the soak into a
+    # throttled run; the throttled-convergence property itself is pinned in
+    # tests/test_throttle.py::test_throttled_hundred_job_soak.
     cluster = KubernetesCluster(
-        KubeConfig(host=base_url, namespace="default"), namespace="default")
+        KubeConfig(host=base_url, namespace="default"), namespace="default",
+        qps=float(os.environ.get("BENCH_K8S_QPS", "0")),
+        burst=int(os.environ.get("BENCH_K8S_BURST", "10")))
     controller = TPUJobController(
         cluster, config=ReconcilerConfig(reconciler_sync_loop_period=0.25),
         threadiness=4)

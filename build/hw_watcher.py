@@ -115,6 +115,12 @@ def bench_complete(path: str) -> bool:
                   if str(s.get("stage", "")).startswith(
                       ("throughput", "attention")))
     partial = bool(doc.get("partial_rc") or doc.get("error"))
+    # The second model is corroboration the watcher's bench always runs
+    # (it never sets BENCH_SKIP_SECOND_MODEL): absent entirely means every
+    # rung of its ladder died, which must not promote as complete.
+    other = "resnet" if str(doc.get("metric", "")).startswith("lm") else "lm"
+    if not isinstance(doc.get(other), dict):
+        partial = True
     for sub in ("lm", "resnet"):
         if isinstance(doc.get(sub), dict) and doc[sub].get("partial_rc"):
             partial = True

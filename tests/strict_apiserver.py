@@ -100,6 +100,10 @@ class StrictApiServer:
         self._history_window = history_window
         self._watchers: List[Tuple[str, "queue.Queue"]] = []
         self.requests: List[Tuple[str, str]] = []
+        # Plurals whose CRD is "not installed": every verb answers 404 the
+        # way a real apiserver does before `kubectl apply -f crd.yaml`
+        # (exercises the operator's startup check_crd_exists branch).
+        self.missing_plurals: set = set()
 
         server = self
 
@@ -129,6 +133,14 @@ class StrictApiServer:
                     "code": code, "reason": reason, "message": message,
                 })
 
+            def _crd_missing(self, plural) -> bool:
+                if plural in server.missing_plurals:
+                    self._status(
+                        404, "NotFound",
+                        "the server could not find the requested resource")
+                    return True
+                return False
+
             def _route(self):
                 parts = urlsplit(self.path)
                 m = _ROUTE.match(parts.path)
@@ -146,6 +158,8 @@ class StrictApiServer:
                 if route is None:
                     return self._status(404, "NotFound", f"no route {self.path}")
                 group, ns, plural, name, sub, params = route
+                if self._crd_missing(plural):
+                    return None
                 if params.get("watch") == "true":
                     return self._watch(plural, ns, params)
                 if sub == "log":
@@ -180,6 +194,8 @@ class StrictApiServer:
                 if route is None:
                     return self._status(404, "NotFound", f"no route {self.path}")
                 group, ns, plural, name, sub, _params = route
+                if self._crd_missing(plural):
+                    return None
                 body = self._body()
                 if sub == "eviction":
                     return self._evict(ns, name)
@@ -205,6 +221,8 @@ class StrictApiServer:
                 if route is None or not route[3]:
                     return self._status(404, "NotFound", f"no route {self.path}")
                 group, ns, plural, name, sub, _params = route
+                if self._crd_missing(plural):
+                    return None
                 body = self._body()
                 with server._lock:
                     current = server._get(plural, ns, name)
@@ -244,6 +262,8 @@ class StrictApiServer:
                 if route is None or not route[3]:
                     return self._status(404, "NotFound", f"no route {self.path}")
                 _group, ns, plural, name, sub, _params = route
+                if self._crd_missing(plural):
+                    return None
                 patch = self._body()
                 with server._lock:
                     current = server._get(plural, ns, name)
@@ -268,6 +288,8 @@ class StrictApiServer:
                 if route is None or not route[3]:
                     return self._status(404, "NotFound", f"no route {self.path}")
                 _group, ns, plural, name, _sub, _params = route
+                if self._crd_missing(plural):
+                    return None
                 with server._lock:
                     obj = server._delete(plural, ns, name)
                 if obj is None:

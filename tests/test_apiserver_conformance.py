@@ -40,7 +40,8 @@ def k8s(request):
     server = SERVERS[request.param]()
     url = server.start()
     cluster = KubernetesCluster(
-        KubeConfig(host=url, namespace="default"), namespace="default"
+        KubeConfig(host=url, namespace="default"), namespace="default",
+        qps=0,  # unthrottled: these tests measure behavior, not rate limits
     )
     yield server, cluster
     cluster.close()
@@ -211,7 +212,8 @@ def strict():
     server = StrictApiServer(history_window=8)
     url = server.start()
     cluster = KubernetesCluster(
-        KubeConfig(host=url, namespace="default"), namespace="default"
+        KubeConfig(host=url, namespace="default"), namespace="default",
+        qps=0,  # unthrottled: these tests measure behavior, not rate limits
     )
     yield server, cluster
     cluster.close()

@@ -54,7 +54,8 @@ def k8s(request):
         server = FakeApiServer()
     url = server.start()
     cluster = KubernetesCluster(
-        KubeConfig(host=url, namespace="default"), namespace="default"
+        KubeConfig(host=url, namespace="default"), namespace="default",
+        qps=0,  # unthrottled: these tests measure behavior, not rate limits
     )
     yield server, cluster
     cluster.close()
@@ -158,7 +159,7 @@ def test_in_process_mechanism_uses_operator_podgroup_crd():
     url = server.start()
     cluster = KubernetesCluster(
         KubeConfig(host=url, namespace="default"), namespace="default",
-        podgroup_api=TPU_PODGROUP_API,
+        podgroup_api=TPU_PODGROUP_API, qps=0,
     )
     try:
         cluster.create_podgroup(PodGroup(

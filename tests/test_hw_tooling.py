@@ -153,6 +153,13 @@ class TestBenchComplete:
         doc["attention"]["gqa_arm"]["partial_rc"] = -9
         assert not hw.bench_complete(self.write(tmp_path, doc))
 
+    def test_missing_second_model_rejected(self, hw, tmp_path):
+        # every rung of the corroboration model's ladder died -> the key
+        # is absent from the compact doc -> must not promote as complete
+        doc = self.doc()
+        del doc["resnet"]
+        assert not hw.bench_complete(self.write(tmp_path, doc))
+
     def test_missing_attention_rejected(self, hw, tmp_path):
         assert not hw.bench_complete(
             self.write(tmp_path, self.doc(attention=False)))

@@ -37,7 +37,8 @@ def k8s():
     server = FakeApiServer()
     url = server.start()
     cluster = KubernetesCluster(
-        KubeConfig(host=url, namespace="default"), namespace="default"
+        KubeConfig(host=url, namespace="default"), namespace="default",
+        qps=0,  # unthrottled: these tests measure behavior, not rate limits
     )
     yield server, cluster
     cluster.close()

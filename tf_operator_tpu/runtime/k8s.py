@@ -382,6 +382,10 @@ class ApiError(RuntimeError):
         self.code = code
 
 
+class CRDNotInstalledError(RuntimeError):
+    """The TPUJob CRD is absent from the cluster (startup check failed)."""
+
+
 class KubeConfig:
     """Connection parameters for one apiserver."""
 
@@ -477,13 +481,63 @@ class KubeConfig:
         )
 
 
+class TokenBucket:
+    """Client-side request throttle (ref: the RESTClient rate limiter the
+    reference configures via --qps/--burst, cmd/tf-operator.v1/app/
+    server.go:102-109, app/options/options.go:81-82): refill at `qps`
+    tokens/sec up to `burst`; acquire() blocks until a token is free, so a
+    hot resync loop back-pressures itself instead of hammering the
+    apiserver.  qps<=0 disables throttling (matching client-go, where a
+    nil limiter means unthrottled)."""
+
+    def __init__(self, qps: float, burst: int,
+                 clock=time.monotonic, sleep=time.sleep) -> None:
+        self.qps = float(qps)
+        self.burst = max(1, int(burst))
+        self._tokens = float(self.burst)
+        self._clock = clock
+        self._sleep = sleep
+        self._last = clock()
+        self._lock = threading.Lock()
+        # observability: how often/long callers were actually held back
+        self.wait_count = 0
+        self.wait_seconds = 0.0
+
+    def acquire(self) -> float:
+        """Take one token, sleeping until it accrues; returns seconds waited.
+
+        Reservation-style (like client-go's rate.Limiter): the token is
+        debited immediately — possibly into the negative — and the caller
+        sleeps off exactly its own deficit.  A recheck loop would be
+        vulnerable to a float-precision livelock: a refill landing at
+        0.999…9 tokens yields a ~1e-17s sleep that a fake or coarse clock
+        absorbs without advancing."""
+        if self.qps <= 0:
+            return 0.0
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(float(self.burst),
+                               self._tokens + (now - self._last) * self.qps)
+            self._last = now
+            self._tokens -= 1.0
+            wait = 0.0 if self._tokens >= 0 else -self._tokens / self.qps
+            if wait:
+                self.wait_count += 1
+                self.wait_seconds += wait
+        if wait:
+            self._sleep(wait)
+        return wait
+
+
 class KubeClient:
     """Minimal REST client: one connection per request (watches hold theirs
     open), JSON in/out, standard k8s error mapping."""
 
-    def __init__(self, config: KubeConfig, timeout: float = 30.0) -> None:
+    def __init__(self, config: KubeConfig, timeout: float = 30.0,
+                 qps: float = 5.0, burst: int = 10) -> None:
         self.config = config
         self.timeout = timeout
+        self.limiter = TokenBucket(qps, burst)
         parts = urlsplit(config.host)
         self._scheme = parts.scheme or "https"
         self._netloc = parts.netloc or parts.path
@@ -517,6 +571,7 @@ class KubeClient:
         (the pod log endpoint serves text/plain, not JSON)."""
         if params:
             path = f"{path}?{urlencode(params)}"
+        self.limiter.acquire()
         conn = self._connect(self.timeout)
         try:
             conn.request(
@@ -549,6 +604,9 @@ class KubeClient:
         reader parked in recv (watch connections have no timeout)."""
         params = dict(params, watch="true")
         full = f"{path}?{urlencode(params)}"
+        # Establishing a watch costs one token (client-go throttles watch
+        # creation the same way); the long-lived stream itself is free.
+        self.limiter.acquire()
         conn = self._connect(None)  # watches are long-lived
         if conn_registry is not None:
             conn_registry.append(conn)
@@ -592,9 +650,10 @@ class KubernetesCluster(ClusterInterface):
 
     def __init__(self, config: Optional[KubeConfig] = None,
                  namespace: Optional[str] = None,
-                 podgroup_api: str = PODGROUP_API) -> None:
+                 podgroup_api: str = PODGROUP_API,
+                 qps: float = 5.0, burst: int = 10) -> None:
         self.config = config or default_config()
-        self.client = KubeClient(self.config)
+        self.client = KubeClient(self.config, qps=qps, burst=burst)
         # None = all namespaces (the reference's default, options.go:57-60)
         self.namespace = namespace
         self._job_handlers: List[WatchHandler] = []
@@ -626,6 +685,27 @@ class KubernetesCluster(ClusterInterface):
     def _core_path(namespace: str, kind: str, name: str = "") -> str:
         base = f"/api/v1/namespaces/{namespace}/{kind}"
         return f"{base}/{name}" if name else base
+
+    # -- startup checks --
+
+    def check_crd_exists(self) -> None:
+        """Fail fast with an actionable error when the TPUJob CRD isn't
+        installed (ref: checkCRDExists, cmd/tf-operator.v1/app/
+        server.go:215-227): without this, a missing CRD surfaces as opaque
+        404s from the middle of the reconcile loop."""
+        ns = self.namespace or self.config.namespace
+        base = f"/apis/{constants.API_GROUP}/{constants.API_VERSION}"
+        path = (f"{base}/namespaces/{ns}/{constants.PLURAL}" if ns
+                else f"{base}/{constants.PLURAL}")
+        try:
+            self.client.request("GET", path, params={"limit": "1"})
+        except NotFound as e:
+            raise CRDNotInstalledError(
+                f"TPUJob CRD ({constants.PLURAL}.{constants.API_GROUP} "
+                f"{constants.API_VERSION}) is not installed on this cluster "
+                f"(LIST {path} -> 404: {e}); install it with "
+                "`kubectl apply -f manifests/crd.yaml` and restart the "
+                "operator") from e
 
     # -- jobs --
 

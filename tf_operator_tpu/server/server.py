@@ -79,6 +79,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="kubeconfig path for --runtime k8s (default: "
                              "in-cluster service account, then $KUBECONFIG, "
                              "then ~/.kube/config — ref: server.go:94-99)")
+    parser.add_argument("--qps", type=float, default=5.0,
+                        help="maximum QPS to the apiserver from this client; "
+                             "<=0 disables throttling (ref: options.go:81)")
+    parser.add_argument("--burst", type=int, default=10,
+                        help="maximum burst for throttle (ref: options.go:82)")
     return parser
 
 
@@ -194,11 +199,22 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
                 # Volcano group so a cluster-installed Volcano sees them.
                 podgroup_api=(TPU_PODGROUP_API if gang_in_process
                               else PODGROUP_API),
+                qps=args.qps, burst=args.burst,
             )
         elif args.runtime == "local":
             cluster = LocalProcessCluster(workdir=args.workdir)
         else:
             cluster = InMemoryCluster()
+
+    # Fail fast before any controller machinery starts when the CRD isn't
+    # installed (ref: checkCRDExists, server.go:215-227).  Injected test
+    # clusters without the check (in-memory/local) skip it.
+    if hasattr(cluster, "check_crd_exists"):
+        try:
+            cluster.check_crd_exists()
+        except Exception as e:
+            log.error("CRD check failed: %s", e)
+            raise SystemExit(str(e))
 
     config = ReconcilerConfig(
         reconciler_sync_loop_period=args.resync_period,
