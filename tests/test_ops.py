@@ -397,6 +397,47 @@ class TestAutotune:
             1, 2, 64, 8, reps=1, candidates=[(128, 128), (64, 64)])
         assert loaded == {k: v for k, v in result.items()}
 
+    def test_kernel_edit_invalidates_persisted_cache(self, tmp_path,
+                                                     monkeypatch):
+        """The cache key carries a hash of ops/attention.py's source: a
+        kernel edit must invalidate persisted tuned blocks (VERDICT r04
+        #10 — silent wrong-config reuse is a perf heisenbug factory)."""
+        from tf_operator_tpu.ops import autotune
+
+        monkeypatch.setenv("TPUJOB_AUTOTUNE_CACHE",
+                           str(tmp_path / "tune.json"))
+        autotune._CACHE.clear()
+        shape = dict(b=1, h=2, t=64, d=8)
+        args = (shape["b"], shape["h"], shape["t"], shape["d"])
+        result = autotune.tune_flash_blocks(
+            *args, reps=1, candidates=[(64, 64)])
+        assert "block_q" in result
+
+        # poison the persisted entry's timing; an unchanged kernel must be
+        # served the poisoned value (proving the file cache is actually read)
+        import json as _json
+
+        path = tmp_path / "tune.json"
+        table = _json.loads(path.read_text())
+        (key,) = table.keys()
+        assert autotune._kernel_source_hash() in key
+        table[key]["ms"] = 123456.0
+        path.write_text(_json.dumps(table))
+        autotune._CACHE.clear()
+        served = autotune.tune_flash_blocks(
+            *args, reps=1, candidates=[(64, 64)])
+        assert served["ms"] == 123456.0
+
+        # simulate a kernel edit: the hash changes -> the poisoned entry is
+        # NOT served; the search re-runs and stores under the new key
+        autotune._CACHE.clear()
+        monkeypatch.setattr(autotune, "_KERNEL_HASH", "deadbeefdeadbeef")
+        fresh = autotune.tune_flash_blocks(
+            *args, reps=1, candidates=[(64, 64)])
+        assert fresh["ms"] != 123456.0
+        table = _json.loads(path.read_text())
+        assert len(table) == 2  # old entry retained, new entry added
+
     def test_env_default_blocks(self, monkeypatch):
         from tf_operator_tpu.ops.attention import default_blocks
 

@@ -13,7 +13,7 @@ import time
 import pytest
 
 from strict_apiserver import StrictApiServer
-from testutil import new_tpujob
+from testutil import new_tpujob, start_kubelet_sim
 
 from tf_operator_tpu.controller.controller import TPUJobController
 from tf_operator_tpu.runtime.k8s import (
@@ -192,20 +192,7 @@ def test_throttled_hundred_job_soak(strict):
         cluster, config=ReconcilerConfig(reconciler_sync_loop_period=0.25),
         threadiness=4)
     controller.start()
-    stop = threading.Event()
-
-    def kubelet():
-        while not stop.is_set():
-            for name, obj in server.objects("pods").items():
-                if not (obj.get("status") or {}).get("phase"):
-                    server.set_pod_status(
-                        "default", name,
-                        {"phase": "Running", "containerStatuses": [
-                            {"name": "tensorflow", "state": {"running": {}}}]})
-            stop.wait(0.01)
-
-    kubelet_thread = threading.Thread(target=kubelet, daemon=True)
-    kubelet_thread.start()
+    stop_kubelet = start_kubelet_sim(server)
     n = 100
     try:
         for i in range(n):
@@ -232,6 +219,6 @@ def test_throttled_hundred_job_soak(strict):
         assert limiter.wait_count > 0, "limiter never engaged"
         assert limiter.wait_seconds > 0
     finally:
-        stop.set()
+        stop_kubelet()
         controller.stop()
         cluster.close()

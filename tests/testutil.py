@@ -146,3 +146,53 @@ def new_controller(enable_gang: bool = False):
     controller.reconciler.pod_control = fake_pods
     controller.reconciler.service_control = fake_services
     return controller, cluster, fake_pods, fake_services
+
+
+def start_kubelet_sim(server, *, feed_logs: bool = False,
+                      namespace: str = "default", interval: float = 0.01):
+    """Kubelet simulator for the apiserver fixtures: a daemon thread that
+    marks every phase-less pod Running (containerStatuses included) and,
+    with feed_logs, echoes the pod's own TF_CONFIG env into its log
+    stream first — the fixture analogue of the busybox echo command the
+    real-cluster E2E uses.  Pods deleted between the snapshot and the
+    status write are skipped (the fixtures raise KeyError there; dying
+    silently would turn a benign delete race into a convergence timeout).
+
+    Returns stop() — call it to join the thread."""
+    import threading as _threading
+
+    stop_event = _threading.Event()
+
+    def loop():
+        while not stop_event.is_set():
+            for name, obj in server.objects("pods", namespace).items():
+                if (obj.get("status") or {}).get("phase"):
+                    continue
+                try:
+                    if feed_logs:
+                        env = {}
+                        for c in (obj.get("spec") or {}).get(
+                                "containers") or []:
+                            for e in c.get("env") or []:
+                                env[e.get("name")] = e.get("value")
+                        server.set_pod_log(
+                            namespace, name,
+                            f"TF_CONFIG={env.get('TF_CONFIG', '')}\n")
+                    server.set_pod_status(
+                        namespace, name,
+                        {"phase": "Running", "containerStatuses": [
+                            {"name": "tensorflow",
+                             "state": {"running": {}}}]})
+                except KeyError:
+                    continue  # deleted since the snapshot
+            stop_event.wait(interval)
+
+    thread = _threading.Thread(target=loop, daemon=True,
+                               name="kubelet-sim")
+    thread.start()
+
+    def stop():
+        stop_event.set()
+        thread.join(timeout=5)
+
+    return stop

@@ -71,6 +71,8 @@ class TPUJobController(JobPlugin):
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._sync_errors: Dict[str, str] = {}
+        # job keys already warned about disabled multislice emission
+        self._multislice_warned: set = set()
 
         cluster.watch_jobs(self._on_job_event)
         cluster.watch_pods(self._on_pod_event)
@@ -89,6 +91,7 @@ class TPUJobController(JobPlugin):
             # Pods/services are garbage-collected by ownership in real k8s;
             # our substrates clean up on terminal state instead.
             self.expectations.delete_expectations(job.key())
+            self._multislice_warned.discard(job.key())
 
     def add_job(self, job: TPUJob) -> None:
         """Admission: validate, default, stamp JobCreated, enqueue
@@ -260,7 +263,23 @@ class TPUJobController(JobPlugin):
     # JobPlugin hooks
 
     def set_cluster_spec(self, job: TPUJob, pod: Pod, rtype: ReplicaType, index: int) -> None:
-        topology.set_cluster_spec(job, pod, rtype, index, self.resolver)
+        def warn(reason: str, message: str) -> None:
+            # One Warning Event per job, not one per pod per resync: the
+            # condition is a property of the spec, which is immutable for
+            # a given generation of pod creations.
+            if job.key() in self._multislice_warned:
+                return
+            self._multislice_warned.add(job.key())
+            self.cluster.record_event(Event(
+                object_kind=job.kind,
+                object_name=job.metadata.name,
+                namespace=job.metadata.namespace,
+                event_type="Warning",
+                reason=reason,
+                message=message,
+            ))
+
+        topology.set_cluster_spec(job, pod, rtype, index, self.resolver, warn)
 
     def is_master_role(
         self, replicas: Dict[ReplicaType, ReplicaSpec], rtype: ReplicaType, index: int

@@ -127,7 +127,9 @@ def jax_process_layout(job: TPUJob) -> List[tuple]:
 
 
 def gen_tpu_env(
-    job: TPUJob, rtype: ReplicaType, index: int, resolver: AddressResolver = dns_resolver
+    job: TPUJob, rtype: ReplicaType, index: int,
+    resolver: AddressResolver = dns_resolver,
+    warn: Optional[Callable[[str, str], None]] = None,
 ) -> Dict[str, str]:
     """The TPU-native topology document, one env-var map per process."""
     env: Dict[str, str] = {
@@ -158,7 +160,7 @@ def gen_tpu_env(
             env[constants.ENV_MESH_SHAPE] = json.dumps(
                 rspec.tpu.mesh, separators=(",", ":")
             )
-        _add_multislice_env(env, job, rtype, rspec, index, resolver)
+        _add_multislice_env(env, job, rtype, rspec, index, resolver, warn)
     return env
 
 
@@ -169,6 +171,7 @@ def _add_multislice_env(
     rspec,
     index: int,
     resolver: AddressResolver,
+    warn: Optional[Callable[[str, str], None]] = None,
 ) -> None:
     """DCN multislice coordination (no reference analogue; SURVEY §7's
     'across slices/DCN, emit coordinator addresses').
@@ -199,14 +202,6 @@ def _add_multislice_env(
         # group; giving it its own MEGASCALE document (coordinator=ps-0)
         # would hand CPU-side pods a conflicting multislice view.
         return
-    sliced_jax_types = [
-        rt for rt in _JAX_PROCESS_TYPES
-        if job.spec.replica_specs.get(rt) is not None
-        and job.spec.replica_specs[rt].tpu is not None
-        and job.spec.replica_specs[rt].tpu.topology
-    ]
-    if len(sliced_jax_types) > 1:
-        return
     try:
         hosts = topology_hosts(rspec.tpu.topology)
     except ValueError:
@@ -214,6 +209,30 @@ def _add_multislice_env(
     replicas = int(rspec.replicas or 0)
     num_slices = max(1, math.ceil(replicas / hosts))
     if num_slices < 2:
+        return
+    sliced_jax_types = [
+        rt for rt in _JAX_PROCESS_TYPES
+        if job.spec.replica_specs.get(rt) is not None
+        and job.spec.replica_specs[rt].tpu is not None
+        and job.spec.replica_specs[rt].tpu.topology
+    ]
+    if len(sliced_jax_types) > 1:
+        # Correct but surprising: the group WOULD span slices, yet no
+        # MEGASCALE document is emitted.  Tell the user why their
+        # multislice job formed no DCN group instead of leaving them to
+        # diff pod env against a working job.
+        if warn is not None:
+            warn(
+                "MultisliceDisabled",
+                f"replica type {rtype.value} spans {num_slices} slices but "
+                "the job has multiple sliced JAX process types ("
+                + ", ".join(rt.value for rt in sliced_jax_types)
+                + "); MEGASCALE_* coordination env was not emitted because "
+                "an inconsistent multislice document across one "
+                "jax.distributed group hangs libtpu init — keep all "
+                "accelerator processes in a single replica type to form a "
+                "DCN group",
+            )
         return
     port = get_port_from_job(job.spec, rtype)
     env[constants.ENV_MEGASCALE_COORDINATOR] = resolver(job, rtype, 0, port)
@@ -227,6 +246,7 @@ def set_cluster_spec(
     rtype: ReplicaType,
     index: int,
     resolver: AddressResolver = dns_resolver,
+    warn: Optional[Callable[[str, str], None]] = None,
 ) -> None:
     """Inject TF_CONFIG + TPU env into the operator container of `pod`
     (ref: SetClusterSpec, pod.go:250-283 — skipped when non-distributed)."""
@@ -237,5 +257,5 @@ def set_cluster_spec(
         return
     if is_distributed(job):
         container.set_env(constants.ENV_TF_CONFIG, gen_tf_config(job, rtype, index, resolver))
-    for name, value in gen_tpu_env(job, rtype, index, resolver).items():
+    for name, value in gen_tpu_env(job, rtype, index, resolver, warn).items():
         container.set_env(name, value)

@@ -27,6 +27,7 @@ multiple of (8, 128) for the (sublane, lane) axes — see
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import time
@@ -43,9 +44,41 @@ DEFAULT_CANDIDATES: List[Tuple[int, int]] = [
 # shape signature -> result dict
 _CACHE: Dict[tuple, dict] = {}
 
+# memoized kernel-source digest (None = not yet computed)
+_KERNEL_HASH: Optional[str] = None
+
 
 def _cache_path() -> Optional[str]:
     return os.environ.get("TPUJOB_AUTOTUNE_CACHE") or None
+
+
+def _kernel_source_hash() -> str:
+    """sha256 (truncated) over ops/attention.py's source bytes.  Part of
+    every cache key: tuned block shapes are only valid for the kernel
+    they were measured on, and a persisted TPUJOB_AUTOTUNE_CACHE entry
+    silently reused across a kernel edit is a perf heisenbug factory —
+    the edit changes VMEM footprint/grid behavior but the stale winner
+    keeps being applied."""
+    global _KERNEL_HASH
+    if _KERNEL_HASH is None:
+        try:
+            from . import attention
+
+            with open(attention.__file__, "rb") as f:
+                _KERNEL_HASH = hashlib.sha256(f.read()).hexdigest()[:16]
+        except (OSError, ImportError):
+            # pyc-only / frozen installs: the guard is inactive, which must
+            # not be silent — stale tuned blocks would survive kernel
+            # upgrades with no signal.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "autotune: ops/attention.py source unreadable; kernel-edit "
+                "cache invalidation is DISABLED for this process (persisted "
+                "TPUJOB_AUTOTUNE_CACHE entries may be stale across kernel "
+                "changes)")
+            _KERNEL_HASH = "unknown"
+    return _KERNEL_HASH
 
 
 def _signature(backend, b, h, kv_h, t, d, causal, dtype,
@@ -53,9 +86,10 @@ def _signature(backend, b, h, kv_h, t, d, causal, dtype,
     # backend is part of the key: a CPU run times the XLA fallback (every
     # candidate ties, winner is noise) and must never be served to a TPU
     # run from a shared cache file; candidates/reps too — a result is only
-    # valid for the search it came from.
+    # valid for the search it came from; the kernel-source hash so a
+    # kernel edit invalidates every persisted entry.
     return (backend, b, h, kv_h, t, d, bool(causal), str(dtype),
-            tuple(map(tuple, candidates)), reps)
+            tuple(map(tuple, candidates)), reps, _kernel_source_hash())
 
 
 def _load_persistent(sig: tuple) -> Optional[dict]:

@@ -41,9 +41,12 @@ def chunked_softmax_xent(hidden, table, targets, chunk: int) -> jax.Array:
 
     `hidden` [B, T, D] is the model's pre-readout activations, already
     cast to the model dtype (TransformerLM(..., return_hidden=True)
-    applies the same rounding the full readout does, so chunked and full
-    losses agree to numerical noise); `table` [vocab, D] is the readout
-    matrix."""
+    applies the same rounding the full readout does); `table` [vocab, D]
+    is the readout matrix.  Each chunk's readout uses the exact
+    formulation of the full path's nn.Embed.attend — promote query and
+    table to their common dtype, then jnp.dot — so chunked and full
+    losses agree up to reduction order (pinned at 2e-5 in tests and in
+    the multichip dryrun), never at a lower precision."""
     if chunk <= 0:
         raise ValueError(f"chunk must be positive, got {chunk}")
     b, t, d = hidden.shape
@@ -60,9 +63,9 @@ def chunked_softmax_xent(hidden, table, targets, chunk: int) -> jax.Array:
 
     @jax.checkpoint
     def chunk_nll(hx, yy, mm):
-        logits = jnp.einsum(
-            "bcd,vd->bcv", hx, table, preferred_element_type=jnp.float32
-        )
+        # same formulation as nn.Embed.attend (promote, then dot): bf16
+        # hidden x f32 table runs as an f32 matmul, not a bf16 one
+        logits = jnp.dot(hx, table.T).astype(jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, yy[..., None], axis=-1)[..., 0]
         return jnp.sum(jnp.where(mm, -ll, 0.0))
