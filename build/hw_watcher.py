@@ -57,6 +57,10 @@ MICRO = os.path.join(ART, f"micro_flash_{STAMP}.json")
 MICRO_GQA = os.path.join(ART, f"micro_gqa_{STAMP}.json")
 MICRO_LM = os.path.join(ART, f"micro_lm_{STAMP}.json")
 MICRO_WIN = os.path.join(ART, f"micro_window_{STAMP}.json")
+# The T-sweep probe is RESUMABLE (build/micro_sweep_probe.py): it reloads
+# its own partial output and burns down remaining rungs, so unlike the
+# other micros it must never be parked aside between windows.
+MICRO_SWEEP = os.path.join(ART, f"micro_sweep_{STAMP}.json")
 
 
 def log(msg: str) -> None:
@@ -202,10 +206,13 @@ def do_pytest(expr, timeout, dest, label, paths=("tests/",), extra=()) -> bool:
     return False
 
 
-def do_micro(script: str, out_path: str, label: str) -> bool:
+def do_micro(script: str, out_path: str, label: str,
+             resumable: bool = False) -> bool:
     """A ~1-2 minute-window stage: one of the build/micro_*_probe.py
     scripts, all of which emit their JSON incrementally (a window dying
-    mid-run still leaves the earlier arms on disk)."""
+    mid-run still leaves the earlier arms on disk).  `resumable` probes
+    reload their own partial output and continue, so their partials stay
+    at the final name instead of being parked aside."""
     log(f"stage {label}: starting")
     rc, out, err = run([sys.executable, script, out_path], timeout=420)
     done = micro_complete(out_path)
@@ -214,7 +221,7 @@ def do_micro(script: str, out_path: str, label: str) -> bool:
             log(f"stage {label}: rc={rc} doc={json.load(f)}")
     except (OSError, ValueError):
         log(f"stage {label}: no artifact (rc={rc}); err tail: {err[-200:]!r}")
-    if not done and os.path.exists(out_path):
+    if not done and not resumable and os.path.exists(out_path):
         # keep a partial under another name; retry for the full run
         os.replace(out_path, next_partial(out_path))
     return done
@@ -273,7 +280,7 @@ def stage_done(p: str) -> bool:
                 or (file_green(TIER_OPS) and file_green(TIER_REST)))
     if p == GQA:
         return file_green(p)
-    if p in (MICRO, MICRO_GQA, MICRO_LM, MICRO_WIN):
+    if p in (MICRO, MICRO_GQA, MICRO_LM, MICRO_WIN, MICRO_SWEEP):
         return micro_complete(p)
     return os.path.exists(p)
 
@@ -284,7 +291,7 @@ def main() -> None:
     log(f"watcher up, stamp={STAMP}, budget={MAX_SECONDS / 3600:.1f}h")
     while time.time() - start < MAX_SECONDS:
         pending = [p for p in (MICRO, MICRO_GQA, MICRO_LM, MICRO_WIN,
-                               BENCH, GQA, TIER)
+                               MICRO_SWEEP, BENCH, GQA, TIER)
                    if not stage_done(p)]
         if not pending:
             log("ALL_DONE: every artifact recorded")
@@ -304,6 +311,9 @@ def main() -> None:
             if not stage_done(MICRO_WIN) and probe():
                 do_micro("build/micro_window_probe.py", MICRO_WIN,
                          "micro-window")
+            if not stage_done(MICRO_SWEEP) and probe():
+                do_micro("build/micro_sweep_probe.py", MICRO_SWEEP,
+                         "micro-sweep", resumable=True)
             if not stage_done(BENCH) and probe():
                 do_bench()
             if not stage_done(GQA) and probe():

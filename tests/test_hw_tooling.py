@@ -40,6 +40,7 @@ def hw(tmp_path, monkeypatch):
         ("MICRO_GQA", "micro_gqa_tst.json"),
         ("MICRO_LM", "micro_lm_tst.json"),
         ("MICRO_WIN", "micro_window_tst.json"),
+        ("MICRO_SWEEP", "micro_sweep_tst.json"),
     ):
         setattr(mod, name, str(tmp_path / fname))
     return mod
@@ -197,15 +198,17 @@ class TestStageDone:
 
     def test_micro_stages_routed_to_micro_complete(self, hw, tmp_path):
         for fname in ("micro_flash_tst.json", "micro_gqa_tst.json",
-                      "micro_lm_tst.json", "micro_window_tst.json"):
+                      "micro_lm_tst.json", "micro_window_tst.json",
+                      "micro_sweep_tst.json"):
             (tmp_path / fname).write_text(json.dumps(
                 {"on_tpu": True, "total_sec": 9.0}))
-        for p in (hw.MICRO, hw.MICRO_GQA, hw.MICRO_LM, hw.MICRO_WIN):
+        for p in (hw.MICRO, hw.MICRO_GQA, hw.MICRO_LM, hw.MICRO_WIN,
+                  hw.MICRO_SWEEP):
             assert hw.stage_done(p)
 
     def test_absent_artifacts_pending(self, hw):
         for p in (hw.BENCH, hw.GQA, hw.TIER, hw.MICRO, hw.MICRO_GQA,
-                  hw.MICRO_LM, hw.MICRO_WIN):
+                  hw.MICRO_LM, hw.MICRO_WIN, hw.MICRO_SWEEP):
             assert not hw.stage_done(p)
 
 
@@ -215,3 +218,94 @@ class TestNextPartial:
         assert hw.next_partial(dst) == str(tmp_path / "bench_tst_partial1.json")
         (tmp_path / "bench_tst_partial1.json").write_text("{}")
         assert hw.next_partial(dst) == str(tmp_path / "bench_tst_partial2.json")
+
+
+class TestSweepProbe:
+    """build/micro_sweep_probe.py's resume logic (pure, off-chip): the
+    probe must know exactly which rungs remain for any partial doc, and a
+    resumable partial must NOT be parked aside by do_micro."""
+
+    @pytest.fixture()
+    def sweep(self):
+        spec = importlib.util.spec_from_file_location(
+            "micro_sweep_under_test",
+            str(REPO / "build" / "micro_sweep_probe.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_fresh_doc_orders_by_evidence_value(self, sweep):
+        units = sweep.pending_units({})
+        assert units[0] == ("speed", 4096)
+        assert units[1] == ("window", 4096)
+        assert units[2] == ("window", 8192)
+        assert set(units) == {("speed", 4096), ("window", 4096),
+                              ("window", 8192), ("speed", 8192),
+                              ("speed", 1024), ("window", 1024)}
+
+    def test_partial_doc_resumes_at_remaining_rungs(self, sweep):
+        doc = {"rungs": {
+            "4096": {"flash_ms": 1.0, "xla_ms": 2.0, "speedup": 2.0,
+                     "window_ms": 0.4, "window_speedup": 2.5},
+            "8192": {"flash_ms": 4.0, "window_ms": 1.0,
+                     "window_speedup": 4.0},
+        }}
+        units = sweep.pending_units(doc)
+        assert ("speed", 4096) not in units
+        assert ("window", 4096) not in units
+        assert ("window", 8192) not in units
+        assert ("speed", 8192) in units  # xla arm still missing
+        assert ("speed", 1024) in units
+
+    def test_recorded_errors_retire_units(self, sweep):
+        # an OOM'd XLA arm is data, not pending work
+        doc = {"rungs": {"8192": {"flash_ms": 4.0, "xla_error": "RESOURCE",
+                                  "window_ms": 1.0}}}
+        assert ("speed", 8192) not in sweep.pending_units(doc)
+        assert ("window", 8192) not in sweep.pending_units(doc)
+
+    def test_autotune_gates_on_measured_speedup(self, sweep):
+        doc = {"rungs": {
+            "4096": {"flash_ms": 1.0, "xla_ms": 1.05, "speedup": 1.05,
+                     "window_ms": 0.4},
+            "8192": {"flash_ms": 1.0, "xla_ms": 1.0, "speedup": 1.0,
+                     "window_ms": 0.4},
+            "1024": {"flash_ms": 1.0, "xla_ms": 1.5, "speedup": 1.5,
+                     "window_ms": 0.4},
+        }}
+        units = sweep.pending_units(doc)
+        # below the 1.2x bar at 4096/8192 -> tune, largest t first;
+        # 1024 already clears the bar -> no tune
+        assert ("tune", 8192) in units and ("tune", 4096) in units
+        assert ("tune", 1024) not in units
+        assert units.index(("tune", 8192)) < units.index(("tune", 4096))
+        # a completed (or failed) search retires the unit
+        doc["rungs"]["8192"]["tuned_blocks"] = [256, 256]
+        doc["rungs"]["4096"]["autotune_error"] = "no candidate compiled"
+        assert not [u for u in sweep.pending_units(doc) if u[0] == "tune"]
+
+    def test_resumable_partial_not_parked(self, hw, tmp_path, monkeypatch):
+        partial = {"on_tpu": True, "rungs": {"4096": {"flash_ms": 1.0}}}
+        out = tmp_path / "micro_sweep_tst.json"
+        out.write_text(json.dumps(partial))
+        monkeypatch.setattr(hw, "run", lambda *a, **k: (0, "", ""))
+        done = hw.do_micro("build/micro_sweep_probe.py", str(out),
+                           "micro-sweep", resumable=True)
+        assert not done
+        assert out.exists(), "resumable partial must stay at its name"
+        assert not list(tmp_path.glob("*_partial*"))
+        # non-resumable micros keep the parking behavior
+        out2 = tmp_path / "micro_flash_tst.json"
+        out2.write_text(json.dumps({"on_tpu": True}))
+        done = hw.do_micro("build/micro_tpu_probe.py", str(out2), "micro")
+        assert not done and not out2.exists()
+        assert (tmp_path / "micro_flash_tst_partial1.json").exists()
+
+    def test_transient_vs_oom_classification(self, sweep):
+        # OOM / Mosaic lowering failures are data (retire the arm)...
+        assert sweep._is_oom(RuntimeError("RESOURCE_EXHAUSTED: vmem"))
+        assert sweep._is_oom(RuntimeError("Mosaic lowering failed: op"))
+        # ...a dropped tunnel is not (unit must stay pending)
+        assert not sweep._is_oom(RuntimeError(
+            "UNAVAILABLE: failed to connect to all addresses"))
+        assert not sweep._is_oom(TimeoutError("deadline exceeded"))
