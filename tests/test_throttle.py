@@ -116,6 +116,13 @@ class TestWireThrottle:
         assert took >= 0.06
         assert client.limiter.wait_count >= 3
         assert client.limiter.wait_seconds > 0
+        # throttling is observable on /metrics (client-go parity)
+        from tf_operator_tpu.utils import metrics
+
+        assert metrics.client_throttle_waits.labels().get() >= 3
+        assert metrics.client_throttle_wait_seconds.labels().get() > 0
+        rendered = metrics.REGISTRY.render()
+        assert "tpu_operator_client_throttle_waits_total" in rendered
 
     def test_server_flags_exist_with_reference_defaults(self):
         from tf_operator_tpu.server.server import build_arg_parser

@@ -52,6 +52,7 @@ from ..api.core import (
 )
 from ..api.types import JobStatus, TPUJob
 from ..utils import logging as tpulog
+from ..utils import metrics
 from .cluster import (
     AlreadyExists,
     ClusterInterface,
@@ -551,6 +552,17 @@ class KubeClient:
                 ctx.load_cert_chain(config.cert_file, config.key_file)
             self._ssl = ctx
 
+    def _throttle(self) -> None:
+        """Take a limiter token; report actual waits on /metrics.  The
+        emission lives here, not in TokenBucket, so the bucket stays a
+        side-effect-free utility (fake-clock test instances must not
+        pollute the production counter) and the metric unambiguously
+        means 'this process's apiserver client'."""
+        waited = self.limiter.acquire()
+        if waited:
+            metrics.client_throttle_waits.labels().inc()
+            metrics.client_throttle_wait_seconds.labels().inc(waited)
+
     def _connect(self, timeout: Optional[float]):
         if self._scheme == "https":
             return HTTPSConnection(self._netloc, timeout=timeout, context=self._ssl)
@@ -571,7 +583,7 @@ class KubeClient:
         (the pod log endpoint serves text/plain, not JSON)."""
         if params:
             path = f"{path}?{urlencode(params)}"
-        self.limiter.acquire()
+        self._throttle()
         conn = self._connect(self.timeout)
         try:
             conn.request(
@@ -606,7 +618,7 @@ class KubeClient:
         full = f"{path}?{urlencode(params)}"
         # Establishing a watch costs one token (client-go throttles watch
         # creation the same way); the long-lived stream itself is free.
-        self.limiter.acquire()
+        self._throttle()
         conn = self._connect(None)  # watches are long-lived
         if conn_registry is not None:
             conn_registry.append(conn)

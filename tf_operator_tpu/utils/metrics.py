@@ -162,3 +162,14 @@ waiting_gangs = REGISTRY.gauge(
     "tpu_operator_waiting_gangs",
     "Gangs currently waiting for capacity or slice shapes",
 )
+# Client-side apiserver throttle (the reference's client-go exposes its
+# RESTClient rate-limiter latency the same way; here the TokenBucket in
+# runtime/k8s.py feeds these when a request actually waits).
+client_throttle_waits = REGISTRY.counter(
+    "tpu_operator_client_throttle_waits_total",
+    "Apiserver requests delayed by the client-side QPS limiter",
+)
+client_throttle_wait_seconds = REGISTRY.counter(
+    "tpu_operator_client_throttle_wait_seconds_total",
+    "Total seconds requests spent waiting on the client-side QPS limiter",
+)
