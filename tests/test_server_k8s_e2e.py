@@ -42,9 +42,11 @@ users: []
     server.stop()
 
 
-def _server_cmd(kubeconfig, *extra):
+def _server_cmd(kubeconfig, *extra, master=None):
+    conn = (["--master", master] if master
+            else ["--kubeconfig", kubeconfig])
     return [sys.executable, "-m", "tf_operator_tpu.server",
-            "--runtime", "k8s", "--kubeconfig", kubeconfig,
+            "--runtime", "k8s", *conn,
             "--monitoring-port", "0", "--api-port", "0", *extra]
 
 
@@ -57,6 +59,44 @@ def test_missing_crd_fails_fast_with_install_command(strict_with_kubeconfig):
         timeout=60, cwd=REPO)
     assert proc.returncode != 0
     assert "manifests/crd.yaml" in (proc.stderr + proc.stdout)
+
+
+@pytest.mark.slow
+def test_master_flag_overrides_kubeconfig_host(strict_with_kubeconfig,
+                                               tmp_path):
+    """--master alone (no kubeconfig) reaches the fixture and passes the
+    CRD check, mirroring clientcmd.BuildConfigFromFlags precedence."""
+    server, url, kubeconfig = strict_with_kubeconfig
+    env = {k: v for k, v in os.environ.items() if k != "KUBECONFIG"}
+    env["HOME"] = "/nonexistent"  # no ~/.kube/config fallback either
+    log_path = tmp_path / "server.log"
+    log_file = open(log_path, "w")
+    proc = subprocess.Popen(
+        _server_cmd(kubeconfig, master=url),
+        stdout=log_file, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO, env=env)
+    try:
+        def crd_check_seen():
+            return any(p == "GET" and "tpujobs" in path
+                       for p, path in list(server.requests))
+
+        def server_log():
+            log_file.flush()
+            return log_path.read_text()[-2000:]
+
+        deadline = time.time() + 30
+        while time.time() < deadline and not crd_check_seen():
+            assert proc.poll() is None, f"server died: {server_log()}"
+            time.sleep(0.2)
+        # the CRD check LISTed tpujobs over the wire via --master
+        assert crd_check_seen(), f"no tpujobs LIST; log: {server_log()}"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        log_file.close()
 
 
 @pytest.mark.slow

@@ -79,6 +79,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="kubeconfig path for --runtime k8s (default: "
                              "in-cluster service account, then $KUBECONFIG, "
                              "then ~/.kube/config — ref: server.go:94-99)")
+    parser.add_argument("--master", default=None,
+                        help="apiserver address override for --runtime k8s "
+                             "(takes precedence over the kubeconfig host, "
+                             "ref: options.go:44-47)")
     parser.add_argument("--qps", type=float, default=5.0,
                         help="maximum QPS to the apiserver from this client; "
                              "<=0 disables throttling (ref: options.go:81)")
@@ -192,6 +196,23 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
                 if args.kubeconfig
                 else None  # in-cluster / $KUBECONFIG resolution
             )
+            if args.master:
+                # --master overrides the kubeconfig/in-cluster host, like
+                # clientcmd.BuildConfigFromFlags (ref: server.go:94-99)
+                if kube is None:
+                    from ..runtime.k8s import default_config
+
+                    try:
+                        kube = default_config()
+                    except FileNotFoundError:
+                        # no kubeconfig anywhere: a bare-master setup
+                        # (unauthenticated endpoint, e.g. a test fixture
+                        # or kubectl proxy)
+                        kube = KubeConfig(host=args.master)
+                    # a PRESENT-but-broken kubeconfig still raises: the
+                    # reference surfaces parse errors at startup rather
+                    # than silently dropping the credentials it carries
+                kube.host = args.master.rstrip("/")
             cluster = KubernetesCluster(
                 kube, namespace=args.namespace or None,
                 # In-process gang admission uses the operator's own PodGroup
