@@ -127,8 +127,14 @@ class TestWireThrottle:
     def test_server_flags_exist_with_reference_defaults(self):
         from tf_operator_tpu.server.server import build_arg_parser
 
-        args = build_arg_parser().parse_args([])
+        parser = build_arg_parser()
+        args = parser.parse_args([])
         assert args.qps == 5.0 and args.burst == 10  # ref options.go:81-82
+        assert args.resync_period == 15.0
+        # the reference's typo'd spelling (options.go:79) is accepted so
+        # its Deployment args run unmodified
+        assert parser.parse_args(
+            ["--resyc-period", "30"]).resync_period == 30.0
 
     def test_cluster_passes_qps_to_client(self, strict):
         _server, url = strict

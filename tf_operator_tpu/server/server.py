@@ -68,6 +68,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--api-port", type=int, default=8008,
                         help="REST API port; 0 disables")
     parser.add_argument("--resync-period", type=float, default=15.0)
+    # the reference's actual spelling is the typo'd --resyc-period
+    # (options.go:79); accept it so reference Deployment args run
+    # unmodified, without advertising it in --help
+    parser.add_argument("--resyc-period", dest="resync_period", type=float,
+                        default=argparse.SUPPRESS, help=argparse.SUPPRESS)
     parser.add_argument("--enable-leader-election", action="store_true")
     parser.add_argument("--workdir", default=".tpujob-local",
                         help="local runtime workdir (logs, state)")
@@ -189,6 +194,7 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
                 TPU_PODGROUP_API,
                 KubeConfig,
                 KubernetesCluster,
+                default_config,
             )
 
             kube = (
@@ -200,8 +206,6 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
                 # --master overrides the kubeconfig/in-cluster host, like
                 # clientcmd.BuildConfigFromFlags (ref: server.go:94-99)
                 if kube is None:
-                    from ..runtime.k8s import default_config
-
                     try:
                         kube = default_config()
                     except FileNotFoundError:
