@@ -169,3 +169,135 @@ def test_mini_yaml_fallback():
     assert data["spec"]["tfReplicaSpecs"]["Worker"]["replicas"] == 4
     containers = data["spec"]["tfReplicaSpecs"]["Worker"]["template"]["spec"]["containers"]
     assert containers[0]["image"] == "kubeflow/tf-dist-mnist-test:1.0"
+
+
+# ---------------------------------------------------------------------------
+# property-based: to_dict . from_dict is a fixpoint on the manifest space
+
+
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis")  # not in the CI workflow's install list
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+_name = st.text(alphabet="abcdefghijklmnopqrstuvwxyz0123456789",
+                min_size=1, max_size=12)
+_rtypes = st.sampled_from(["Worker", "PS", "Chief", "Master", "Evaluator"])
+
+
+@st.composite
+def _replica_spec(draw):
+    spec = {
+        "replicas": draw(st.integers(min_value=0, max_value=8)),
+        "restartPolicy": draw(st.sampled_from(
+            ["Never", "Always", "OnFailure", "ExitCode"])),
+        "template": {"spec": {"containers": [{
+            "name": "tensorflow",
+            "image": draw(_name),
+            **({"command": draw(st.lists(_name, min_size=1, max_size=3))}
+               if draw(st.booleans()) else {}),
+            **({"env": [{"name": draw(_name).upper(),
+                         "value": draw(_name)}]}
+               if draw(st.booleans()) else {}),
+        }]}},
+    }
+    if draw(st.booleans()):
+        spec["tpu"] = {
+            "accelerator": draw(st.sampled_from(
+                ["v5litepod-8", "v5litepod-32", "v6e-64"])),
+            "topology": draw(st.sampled_from(["2x4", "4x8", "8x8"])),
+            **({"mesh": {"dp": 2, "tp": 4}} if draw(st.booleans()) else {}),
+        }
+    return spec
+
+
+@st.composite
+def _job_dict(draw):
+    rtypes = draw(st.lists(_rtypes, min_size=1, max_size=3, unique=True))
+    d = {
+        "apiVersion": "tpu-operator.dev/v1",
+        "kind": "TPUJob",
+        "metadata": {
+            "name": draw(_name),
+            "namespace": draw(_name),
+            **({"labels": draw(st.dictionaries(_name, _name, max_size=2))}
+               if draw(st.booleans()) else {}),
+        },
+        "spec": {
+            "replicaSpecs": {rt: draw(_replica_spec()) for rt in rtypes},
+            # canonical native schema nests run-policy fields under
+            # runPolicy; the reference's inline spellings are accepted on
+            # parse but canonicalized (see the alias-equivalence test)
+            **({"runPolicy": {
+                "backoffLimit": draw(st.integers(min_value=0, max_value=10)),
+                **({"cleanPodPolicy": draw(st.sampled_from(
+                    ["Running", "All", "None"]))}
+                   if draw(st.booleans()) else {}),
+            }} if draw(st.booleans()) else {}),
+        },
+    }
+    return d
+
+
+def _assert_subset(expected, actual, path="$"):
+    """Every field of `expected` must survive into `actual` with the same
+    value (the serializer may ADD defaulted fields, never drop or change
+    one)."""
+    if isinstance(expected, dict):
+        assert isinstance(actual, dict), f"{path}: {actual!r}"
+        for k, v in expected.items():
+            assert k in actual, f"{path}.{k} dropped"
+            _assert_subset(v, actual[k], f"{path}.{k}")
+    elif isinstance(expected, list):
+        assert isinstance(actual, list) and len(actual) == len(expected), (
+            f"{path}: {actual!r} != {expected!r}")
+        for i, v in enumerate(expected):
+            _assert_subset(v, actual[i], f"{path}[{i}]")
+    else:
+        assert actual == expected, f"{path}: {actual!r} != {expected!r}"
+
+
+@settings(max_examples=60, deadline=None)
+@given(_job_dict())
+def test_serialization_fixpoint_property(manifest):
+    """For ANY well-formed manifest: (a) every generated field survives
+    parse -> serialize with its value intact (catches consistent drops on
+    either side), and (b) to_dict(from_dict(.)) reaches a fixpoint in one
+    step (catches asymmetric rename/re-type mismatches) — together, the
+    bug classes that silently corrupt jobs passing through the apiserver
+    round-trip (get -> modify -> update)."""
+    d1 = job_to_dict(job_from_dict(manifest))
+    _assert_subset(manifest, d1)
+    d2 = job_to_dict(job_from_dict(d1))
+    assert d1 == d2
+
+
+def test_inline_run_policy_aliases_canonicalized():
+    """The reference inlines RunPolicy into the spec (spec.cleanPodPolicy,
+    spec.backoffLimit — common/v1 json:\",inline\"); the native schema
+    nests them under spec.runPolicy.  Both spellings must parse to the
+    SAME job, and re-serialization emits only the canonical nested form
+    (stable under further round-trips)."""
+    inline = {
+        "apiVersion": "tpu-operator.dev/v1", "kind": "TPUJob",
+        "metadata": {"name": "alias", "namespace": "default"},
+        "spec": {
+            "cleanPodPolicy": "All",
+            "backoffLimit": 7,
+            "replicaSpecs": {"Worker": {
+                "replicas": 1,
+                "template": {"spec": {"containers": [
+                    {"name": "tensorflow", "image": "x"}]}}}},
+        },
+    }
+    nested = json.loads(json.dumps(inline))
+    spec = nested["spec"]
+    spec["runPolicy"] = {"cleanPodPolicy": spec.pop("cleanPodPolicy"),
+                         "backoffLimit": spec.pop("backoffLimit")}
+    d_inline = job_to_dict(job_from_dict(inline))
+    d_nested = job_to_dict(job_from_dict(nested))
+    assert d_inline == d_nested
+    rp = d_inline["spec"]["runPolicy"]
+    assert rp["cleanPodPolicy"] == "All" and rp["backoffLimit"] == 7
