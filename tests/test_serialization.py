@@ -301,3 +301,34 @@ def test_inline_run_policy_aliases_canonicalized():
     assert d_inline == d_nested
     rp = d_inline["spec"]["runPolicy"]
     assert rp["cleanPodPolicy"] == "All" and rp["backoffLimit"] == 7
+
+
+@settings(max_examples=60, deadline=None)
+@given(_job_dict())
+def test_defaults_idempotent_property(manifest):
+    """set_defaults runs on every watch event (controller.add_job and the
+    reconcile path both call it on fresh copies) — applying it twice must
+    change nothing beyond the first application, or repeated reconciles
+    would see phantom spec drift and re-queue forever."""
+    job = job_from_dict(manifest)
+    set_defaults(job)
+    once = job_to_dict(job)
+    set_defaults(job)
+    assert job_to_dict(job) == once
+
+
+@settings(max_examples=60, deadline=None)
+@given(_job_dict())
+def test_validation_total_property(manifest):
+    """validate() must either accept or raise ValidationError — any other
+    exception on an arbitrary well-formed manifest means a malformed user
+    job can crash the admission path instead of being rejected with a
+    Failed condition (controller.add_job only catches ValidationError)."""
+    from tf_operator_tpu.api.validation import ValidationError
+
+    job = job_from_dict(manifest)
+    set_defaults(job)
+    try:
+        validate(job)
+    except ValidationError:
+        pass
