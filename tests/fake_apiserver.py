@@ -6,6 +6,12 @@ runtime/k8s.py uses: namespaced CRUD with labelSelector/fieldSelector
 filtering, the TPUJob status subresource (merge-patch), pod eviction with a
 toggleable 429, Lease CRUD, and chunked watch streams with initial-list
 resourceVersion semantics.
+
+Scriptable fault hooks (docs/fault-injection.md) let any e2e test exercise
+the failure regime server-side: fail_next() arms per-verb/per-path
+fail-the-next-N rules (any status, optional Retry-After), add_latency()
+stalls matching requests, drop_watches() severs every open watch stream
+mid-flight.  Rules are consumed deterministically in arm order.
 """
 from __future__ import annotations
 
@@ -13,9 +19,29 @@ import json
 import queue
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlsplit
+
+# sentinel pushed into watcher queues by drop_watches(): ends the stream
+# as an abruptly-dying connection would
+_DROP_STREAM = object()
+
+
+class FaultRule:
+    """One armed server-side fault: matches `times` requests, then expires."""
+
+    def __init__(self, method: str, path_re: str, times: int, status: int = 0,
+                 retry_after: Optional[float] = None, latency: float = 0.0,
+                 message: str = "injected fault") -> None:
+        self.method = method
+        self.path_re = re.compile(path_re)
+        self.times = times
+        self.status = status
+        self.retry_after = retry_after
+        self.latency = latency
+        self.message = message
 
 # collection key: (api_root, namespace, kind_plural)
 _COLLECTION_RE = re.compile(
@@ -39,6 +65,7 @@ class FakeApiServer:
         self._event_log: List[Tuple[int, str, dict]] = []
         self.block_evictions = False
         self.requests: List[Tuple[str, str]] = []  # (method, path) log
+        self.fault_rules: List[FaultRule] = []
 
         server = self
 
@@ -54,20 +81,41 @@ class FakeApiServer:
                     return {}
                 return json.loads(self.rfile.read(length))
 
-            def _reply(self, code: int, payload: dict) -> None:
+            def _reply(self, code: int, payload: dict,
+                       headers: Optional[Dict[str, str]] = None) -> None:
                 data = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for name, value in (headers or {}).items():
+                    self.send_header(name, value)
                 self.end_headers()
                 self.wfile.write(data)
 
-            def _error(self, code: int, message: str) -> None:
+            def _error(self, code: int, message: str,
+                       headers: Optional[Dict[str, str]] = None) -> None:
                 self._reply(code, {"kind": "Status", "code": code,
-                                   "message": message})
+                                   "message": message}, headers=headers)
+
+            def _faulted(self, method: str) -> bool:
+                """Consume a matching armed fault rule; True = request was
+                answered with the injected error (stop handling)."""
+                rule = server._pop_fault(method, self.path)
+                if rule is None:
+                    return False
+                if rule.latency:
+                    time.sleep(rule.latency)
+                if not rule.status:
+                    return False  # latency-only: proceed with real handling
+                headers = ({"Retry-After": str(rule.retry_after)}
+                           if rule.retry_after is not None else None)
+                self._error(rule.status, rule.message, headers=headers)
+                return True
 
             def do_GET(self):
                 server.requests.append(("GET", self.path))
+                if self._faulted("GET"):
+                    return
                 parts = urlsplit(self.path)
                 params = {k: v[0] for k, v in parse_qs(parts.query).items()}
                 m = _COLLECTION_RE.match(parts.path)
@@ -140,6 +188,8 @@ class FakeApiServer:
                 try:
                     while True:
                         evt = q.get(timeout=30)
+                        if evt is _DROP_STREAM:
+                            break  # injected mid-stream watch drop
                         if ns and (evt["object"].get("metadata") or {}).get(
                             "namespace"
                         ) != ns:
@@ -157,6 +207,8 @@ class FakeApiServer:
 
             def do_POST(self):
                 server.requests.append(("POST", self.path))
+                if self._faulted("POST"):
+                    return
                 m = _COLLECTION_RE.match(urlsplit(self.path).path)
                 if not m:
                     return self._error(404, f"no route {self.path}")
@@ -196,6 +248,8 @@ class FakeApiServer:
 
             def do_PUT(self):
                 server.requests.append(("PUT", self.path))
+                if self._faulted("PUT"):
+                    return
                 m = _COLLECTION_RE.match(urlsplit(self.path).path)
                 if not m or not m.group("name"):
                     return self._error(404, f"no route {self.path}")
@@ -208,6 +262,8 @@ class FakeApiServer:
 
             def do_PATCH(self):
                 server.requests.append(("PATCH", self.path))
+                if self._faulted("PATCH"):
+                    return
                 m = _COLLECTION_RE.match(urlsplit(self.path).path)
                 if not m or not m.group("name"):
                     return self._error(404, f"no route {self.path}")
@@ -223,6 +279,8 @@ class FakeApiServer:
 
             def do_DELETE(self):
                 server.requests.append(("DELETE", self.path))
+                if self._faulted("DELETE"):
+                    return
                 m = _COLLECTION_RE.match(urlsplit(self.path).path)
                 if not m or not m.group("name"):
                     return self._error(404, f"no route {self.path}")
@@ -295,6 +353,50 @@ class FakeApiServer:
             watchers = [q for wkind, q in self._watchers if wkind == kind]
         for q in watchers:
             q.put(evt)
+
+    # -- scriptable fault hooks (docs/fault-injection.md) --
+
+    def fail_next(self, method: str = "*", path: str = ".*", times: int = 1,
+                  status: int = 500, retry_after: Optional[float] = None,
+                  message: str = "injected fault") -> FaultRule:
+        """Arm: the next `times` requests matching (method, path regex) are
+        answered with `status` (+ optional Retry-After header)."""
+        rule = FaultRule(method, path, times, status=status,
+                         retry_after=retry_after, message=message)
+        with self._lock:
+            self.fault_rules.append(rule)
+        return rule
+
+    def add_latency(self, method: str = "*", path: str = ".*",
+                    times: int = 1, seconds: float = 0.05) -> FaultRule:
+        """Arm: the next `times` matching requests are stalled `seconds`
+        before normal handling."""
+        rule = FaultRule(method, path, times, latency=seconds)
+        with self._lock:
+            self.fault_rules.append(rule)
+        return rule
+
+    def drop_watches(self) -> int:
+        """Sever every open watch stream mid-flight (clients must relist
+        or resume from their resourceVersion).  Returns streams cut."""
+        with self._lock:
+            watchers = list(self._watchers)
+        for _kind, q in watchers:
+            q.put(_DROP_STREAM)
+        return len(watchers)
+
+    def _pop_fault(self, method: str, path: str) -> Optional[FaultRule]:
+        with self._lock:
+            for rule in self.fault_rules:
+                if rule.times <= 0:
+                    continue
+                if rule.method not in ("*", method):
+                    continue
+                if not rule.path_re.search(path):
+                    continue
+                rule.times -= 1
+                return rule
+        return None
 
     # -- lifecycle / test hooks --
 

@@ -1,0 +1,260 @@
+"""Unit tests pinning the KubeClient transient-error retry policy.
+
+Deterministic throughout: a fake clock (no real sleeps), a fixed-upper-bound
+RNG where the jitter shape itself is under test, and a scripted transport so
+every failure sequence is exact.  The wire-level cases (Retry-After header
+parsing, eviction-429 vs generic-429) run against the fake apiserver's fault
+hooks.  See docs/fault-injection.md.
+"""
+import random
+
+import pytest
+
+from fake_apiserver import FakeApiServer
+from testutil import FakeClock
+
+from tf_operator_tpu.runtime.cluster import EvictionBlocked, TooManyRequests
+from tf_operator_tpu.runtime.k8s import (
+    ApiError,
+    ClientHealth,
+    KubeClient,
+    KubeConfig,
+    RetryPolicy,
+    TransportError,
+)
+from tf_operator_tpu.utils import metrics
+
+
+class UpperRng:
+    """uniform() returns its upper bound: jitter collapses to the cap, so
+    backoff growth is exactly observable."""
+
+    def uniform(self, a, b):
+        return b
+
+
+class ScriptedClient(KubeClient):
+    """KubeClient whose transport is a scripted list of outcomes: an
+    Exception instance is raised, anything else is returned."""
+
+    def __init__(self, script, **kw):
+        super().__init__(KubeConfig(host="http://scripted.invalid:1",
+                                    namespace="default"), qps=0, **kw)
+        self.script = list(script)
+        self.calls = 0
+
+    def _request_once(self, method, path, payload, content_type, raw):
+        self.calls += 1
+        action = self.script.pop(0) if self.script else {}
+        if isinstance(action, Exception):
+            raise action
+        return action
+
+
+def make_client(script, **retry_kw):
+    fc = FakeClock()
+    retry_kw.setdefault("rng", UpperRng())
+    client = ScriptedClient(script, retry=RetryPolicy(**retry_kw),
+                            clock=fc.clock, sleep=fc.sleep)
+    return client, fc
+
+
+def counters():
+    return (metrics.api_retries.labels().get(),
+            metrics.api_giveups.labels().get())
+
+
+class TestBackoffMath:
+    def test_backoff_doubles_up_to_max_delay(self):
+        policy = RetryPolicy(base_delay=0.1, max_delay=5.0, rng=UpperRng())
+        assert [policy.backoff(i) for i in range(6)] == pytest.approx(
+            [0.1, 0.2, 0.4, 0.8, 1.6, 3.2])
+        assert policy.backoff(10) == 5.0  # capped
+
+    def test_full_jitter_stays_within_bounds(self):
+        policy = RetryPolicy(base_delay=0.1, max_delay=5.0,
+                             rng=random.Random(42))
+        for attempt in range(10):
+            cap = min(5.0, 0.1 * 2 ** attempt)
+            for _ in range(50):
+                d = policy.backoff(attempt)
+                assert 0.0 <= d <= cap
+
+    def test_retry_after_overrides_jitter(self):
+        policy = RetryPolicy(rng=UpperRng())
+        assert policy.backoff(3, retry_after=7.5) == 7.5
+
+    def test_verb_matrix(self):
+        policy = RetryPolicy()
+        # idempotent verbs: any connection failure, retryable statuses
+        for verb in ("GET", "DELETE"):
+            assert policy.should_retry(verb, connection_error=True,
+                                       before_send=False)
+            for status in (429, 500, 502, 503, 504):
+                assert policy.should_retry(verb, status=status)
+            assert not policy.should_retry(verb, status=404)
+        # writes: connection-before-send only, plus 429
+        for verb in ("POST", "PUT", "PATCH"):
+            assert policy.should_retry(verb, connection_error=True,
+                                       before_send=True)
+            assert not policy.should_retry(verb, connection_error=True,
+                                           before_send=False)
+            assert policy.should_retry(verb, status=429)
+            assert not policy.should_retry(verb, status=500)
+
+
+class TestRetryLoop:
+    def test_get_retries_transient_5xx_then_succeeds(self):
+        client, fc = make_client(
+            [ApiError(500, "boom"), ApiError(503, "busy"), {"ok": 1}])
+        r0, _ = counters()
+        assert client.request("GET", "/x") == {"ok": 1}
+        assert client.calls == 3
+        assert len(fc.slept) == 2
+        assert metrics.api_retries.labels().get() == r0 + 2
+        assert client.health.consecutive_giveups == 0
+
+    def test_429_honors_retry_after_exactly(self):
+        client, fc = make_client(
+            [TooManyRequests("throttled", retry_after=7.5), {}],
+            deadline=30.0)
+        client.request("POST", "/x", body={"a": 1})  # writes retry on 429
+        assert fc.slept == [7.5]
+
+    def test_write_not_retried_after_bytes_sent(self):
+        err = ConnectionResetError("mid-send reset")
+        client, fc = make_client([TransportError(err, before_send=False)])
+        _, g0 = counters()
+        with pytest.raises(ConnectionResetError):
+            client.request("POST", "/x", body={})
+        assert client.calls == 1
+        assert fc.slept == []
+        assert metrics.api_giveups.labels().get() == g0 + 1
+        assert client.health.consecutive_giveups == 1
+
+    def test_write_retried_when_connection_failed_before_send(self):
+        err = ConnectionRefusedError("connect refused")
+        client, fc = make_client([TransportError(err, before_send=True), {}])
+        client.request("POST", "/x", body={})
+        assert client.calls == 2
+
+    def test_post_5xx_not_retried(self):
+        client, _ = make_client([ApiError(500, "boom")])
+        with pytest.raises(ApiError):
+            client.request("POST", "/x", body={})
+        assert client.calls == 1
+        # the server answered: not a giveup, streak resets
+        assert client.health.consecutive_giveups == 0
+
+    def test_deadline_bounds_total_retry_time(self):
+        # base 0.6 with UpperRng: attempt 0 sleeps 0.6 (fits the 1.0s
+        # deadline), attempt 1 would sleep 1.2 (would overshoot) -> giveup.
+        err = ConnectionResetError("down")
+        script = [TransportError(err, before_send=True) for _ in range(10)]
+        client, fc = make_client(script, base_delay=0.6, max_delay=5.0,
+                                 deadline=1.0, max_retries=99)
+        _, g0 = counters()
+        with pytest.raises(ConnectionResetError):
+            client.request("GET", "/x")
+        assert client.calls == 2
+        assert fc.slept == [0.6]
+        assert metrics.api_giveups.labels().get() == g0 + 1
+
+    def test_max_retries_bounds_attempts(self):
+        script = [ApiError(503, "busy")] * 10
+        client, _ = make_client(script, base_delay=0.001, deadline=1e9,
+                                max_retries=2)
+        with pytest.raises(ApiError):
+            client.request("GET", "/x")
+        assert client.calls == 3  # initial + 2 retries
+
+    def test_semantic_errors_pass_straight_through(self):
+        from tf_operator_tpu.runtime.cluster import AlreadyExists, NotFound
+
+        for exc in (NotFound("gone"), AlreadyExists("dup"),
+                    EvictionBlocked("pdb")):
+            client, fc = make_client([exc])
+            with pytest.raises(type(exc)):
+                client.request("GET", "/x")
+            assert client.calls == 1 and fc.slept == []
+
+    def test_success_resets_giveup_streak(self):
+        client, _ = make_client([{}])
+        client.health.record_giveup()
+        client.health.record_giveup()
+        client.request("GET", "/x")
+        assert client.health.consecutive_giveups == 0
+
+
+class TestClientHealth:
+    def test_degraded_threshold_and_recovery_hysteresis(self):
+        health = ClientHealth(threshold=3, recovery_threshold=3)
+        assert not health.degraded()
+        for _ in range(3):
+            health.record_giveup()
+        assert health.degraded()
+        # one success must NOT end the episode (a write landing mid-outage
+        # would otherwise flap it); only a success streak exits
+        health.record_success()
+        assert health.degraded()
+        health.record_giveup()  # outage continues: success streak resets
+        health.record_success()
+        health.record_success()
+        assert health.degraded()
+        health.record_success()
+        assert not health.degraded()
+        assert health.consecutive_giveups == 0
+
+    def test_interleaved_successes_prevent_entry(self):
+        health = ClientHealth(threshold=3)
+        for _ in range(10):
+            health.record_giveup()
+            health.record_giveup()
+            health.record_success()  # streak never reaches 3
+        assert not health.degraded()
+
+
+@pytest.fixture
+def fake():
+    server = FakeApiServer()
+    url = server.start()
+    client = KubeClient(
+        KubeConfig(host=url, namespace="default"), qps=0,
+        retry=RetryPolicy(base_delay=0.005, max_delay=0.05, deadline=5.0))
+    yield server, client
+    server.stop()
+
+
+class TestWireSemantics:
+    def test_generic_429_is_retryable_with_retry_after_header(self, fake):
+        server, client = fake
+        path = "/api/v1/namespaces/default/pods"
+        server.fail_next(method="GET", path=path + "$", times=1,
+                         status=429, retry_after=0.01)
+        r0, _ = counters()
+        result = client.request("GET", path)
+        assert result.get("kind") == "List"
+        assert metrics.api_retries.labels().get() == r0 + 1
+        gets = [p for m, p in server.requests if m == "GET" and p == path]
+        assert len(gets) == 2  # faulted once, then the retry
+
+    def test_eviction_429_is_eviction_blocked_and_final(self, fake):
+        server, client = fake
+        server._put("pods", "default", "victim", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": "victim", "namespace": "default"},
+        }, new=True)
+        server.block_evictions = True
+        path = "/api/v1/namespaces/default/pods/victim/eviction"
+        with pytest.raises(EvictionBlocked):
+            client.request("POST", path, body={"kind": "Eviction"})
+        posts = [p for m, p in server.requests if m == "POST" and p == path]
+        assert len(posts) == 1  # semantic answer: never retried
+
+    def test_server_side_fail_next_counts_down(self, fake):
+        server, client = fake
+        path = "/api/v1/namespaces/default/services"
+        server.fail_next(method="GET", path=path + "$", times=2, status=503)
+        assert client.request("GET", path).get("kind") == "List"
+        gets = [p for m, p in server.requests if m == "GET" and p == path]
+        assert len(gets) == 3

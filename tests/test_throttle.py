@@ -13,7 +13,7 @@ import time
 import pytest
 
 from strict_apiserver import StrictApiServer
-from testutil import new_tpujob, start_kubelet_sim
+from testutil import FakeClock, new_tpujob, start_kubelet_sim
 
 from tf_operator_tpu.controller.controller import TPUJobController
 from tf_operator_tpu.runtime.k8s import (
@@ -24,19 +24,6 @@ from tf_operator_tpu.runtime.k8s import (
     TokenBucket,
 )
 from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
-
-
-class FakeClock:
-    def __init__(self):
-        self.now = 0.0
-        self.slept = []
-
-    def clock(self):
-        return self.now
-
-    def sleep(self, s):
-        self.slept.append(s)
-        self.now += s
 
 
 def make_bucket(qps, burst):
@@ -173,6 +160,32 @@ class TestCRDCheck:
             assert "tpujobs" in msg
         finally:
             cluster.close()
+
+    def test_inconclusive_check_continues_startup(self):
+        """Only a confirmed-absent CRD is fatal: a transient 5xx, an RBAC
+        403, or a connection failure at startup must log-and-continue
+        (the reference's checkCRDExists only treats IsNotFound as fatal),
+        not crash-loop the operator (ADVICE r05)."""
+        import logging
+
+        from tf_operator_tpu.runtime.k8s import ApiError
+        from tf_operator_tpu.server.server import startup_crd_check
+
+        log = logging.getLogger("test-crd-check")
+
+        class Flaky:
+            def __init__(self, exc):
+                self.exc = exc
+
+            def check_crd_exists(self):
+                raise self.exc
+
+        for exc in (ApiError(403, "forbidden"), ApiError(503, "apiserver busy"),
+                    ConnectionRefusedError("down")):
+            startup_crd_check(Flaky(exc), log)  # must not raise
+
+        with pytest.raises(SystemExit):
+            startup_crd_check(Flaky(CRDNotInstalledError("absent")), log)
 
     def test_server_run_fails_fast_on_missing_crd(self, strict):
         server, url = strict

@@ -39,6 +39,22 @@ TEST_NAMESPACE = "default"
 TEST_IMAGE = "test-image:latest"
 
 
+class FakeClock:
+    """Deterministic clock+sleep pair for TokenBucket/RetryPolicy tests:
+    sleep() is logged and advances the clock instead of blocking."""
+
+    def __init__(self):
+        self.now = 0.0
+        self.slept = []
+
+    def clock(self):
+        return self.now
+
+    def sleep(self, s):
+        self.slept.append(s)
+        self.now += s
+
+
 def new_replica_spec(
     replicas: int,
     restart_policy: RestartPolicy = RestartPolicy.NEVER,

@@ -44,6 +44,14 @@ CONTROLLER_NAME = "tpujob-controller"
 
 FAILED_VALIDATION_REASON = "FailedValidation"
 
+# Degraded-mode backstop: when the substrate's ClientHealth reports this many
+# consecutive request giveups (runtime/k8s.py DEGRADED_GIVEUP_THRESHOLD), the
+# resync period widens by this factor so a flapping apiserver isn't hammered
+# by the full-relist loop, and one ClusterDegraded Warning event marks the
+# episode.  Recovery is automatic: the first completed request resets the
+# streak and the next resync tick narrows the period again.
+DEGRADED_RESYNC_FACTOR = 4.0
+
 
 class TPUJobController(JobPlugin):
     def __init__(
@@ -71,8 +79,16 @@ class TPUJobController(JobPlugin):
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._sync_errors: Dict[str, str] = {}
-        # job keys already warned about disabled multislice emission
+        # job keys already warned about disabled multislice emission;
+        # check-and-add under _warned_lock so threadiness>1 emits exactly
+        # one MultisliceDisabled event per job
         self._multislice_warned: set = set()
+        self._warned_lock = threading.Lock()
+        # degraded-mode backstop state (see _check_degraded)
+        self._degraded = False
+        self.resync_period_current = (
+            self.reconciler.config.reconciler_sync_loop_period
+        )
 
         cluster.watch_jobs(self._on_job_event)
         cluster.watch_pods(self._on_pod_event)
@@ -91,7 +107,8 @@ class TPUJobController(JobPlugin):
             # Pods/services are garbage-collected by ownership in real k8s;
             # our substrates clean up on terminal state instead.
             self.expectations.delete_expectations(job.key())
-            self._multislice_warned.discard(job.key())
+            with self._warned_lock:
+                self._multislice_warned.discard(job.key())
 
     def add_job(self, job: TPUJob) -> None:
         """Admission: validate, default, stamp JobCreated, enqueue
@@ -187,11 +204,65 @@ class TPUJobController(JobPlugin):
     def _resync_loop(self) -> None:
         """Periodic full resync (ref: ReconcilerSyncLoopPeriod 15s,
         common/job_controller.go:60-77): the backstop for timer-driven
-        policies (TTL, ActiveDeadlineSeconds) across controller restarts."""
-        period = self.reconciler.config.reconciler_sync_loop_period
-        while not self._stop.wait(timeout=period):
-            for job in self.cluster.list_jobs():
-                self.work_queue.add(job.key())
+        policies (TTL, ActiveDeadlineSeconds) across controller restarts.
+        Under a degraded control plane the period widens (see
+        _check_degraded) and list failures skip the tick instead of killing
+        the thread — the resync loop must outlive any apiserver outage."""
+        base = self.reconciler.config.reconciler_sync_loop_period
+        while not self._stop.wait(timeout=self.resync_period_current):
+            # Whole tick under one guard: the resync thread must never die —
+            # a dead backstop silently disables TTL/deadline policies AND
+            # the degraded-mode detection that matters most mid-outage.
+            try:
+                factor = (DEGRADED_RESYNC_FACTOR if self._check_degraded()
+                          else 1.0)
+                self.resync_period_current = base * factor
+                for job in self.cluster.list_jobs():
+                    self.work_queue.add(job.key())
+            except Exception as err:  # noqa: BLE001 — transient; next tick retries
+                tpulog.logger_for_key("resync").warning(
+                    "resync tick failed: %s", err)
+
+    def _check_degraded(self) -> bool:
+        """Poll the substrate's ClientHealth (duck-typed; absent on
+        in-memory substrates => never degraded).  Emits ClusterDegraded
+        exactly once per episode; recovery is logged and re-arms the
+        event for the next episode."""
+        health = getattr(self.cluster, "health", None)
+        if health is None:
+            return False
+        degraded = health.degraded()
+        if degraded and not self._degraded:
+            self._degraded = True
+            tpulog.logger_for_key("resync").warning(
+                "control plane degraded: %d consecutive request giveups; "
+                "widening resync period x%g",
+                health.consecutive_giveups, DEGRADED_RESYNC_FACTOR)
+            # Best-effort by record_event contract: a failed write while
+            # degraded must not abort the resync loop.  Target the
+            # cluster's own namespace — a namespace-scoped deployment has
+            # no RBAC to write events into "default".
+            namespace = (getattr(self.cluster, "namespace", None)
+                         or getattr(getattr(self.cluster, "config", None),
+                                    "namespace", None)
+                         or "default")
+            self.cluster.record_event(Event(
+                object_kind="TPUJob",
+                object_name=CONTROLLER_NAME,
+                namespace=namespace,
+                event_type="Warning",
+                reason="ClusterDegraded",
+                message=(
+                    f"{health.consecutive_giveups} consecutive apiserver "
+                    f"request giveups; resync period widened "
+                    f"x{DEGRADED_RESYNC_FACTOR:g} until the control plane "
+                    "recovers"),
+            ))
+        elif not degraded and self._degraded:
+            self._degraded = False
+            tpulog.logger_for_key("resync").info(
+                "control plane recovered; resync period restored")
+        return degraded
 
     def stop(self) -> None:
         self._stop.set()
@@ -267,9 +338,10 @@ class TPUJobController(JobPlugin):
             # One Warning Event per job, not one per pod per resync: the
             # condition is a property of the spec, which is immutable for
             # a given generation of pod creations.
-            if job.key() in self._multislice_warned:
-                return
-            self._multislice_warned.add(job.key())
+            with self._warned_lock:
+                if job.key() in self._multislice_warned:
+                    return
+                self._multislice_warned.add(job.key())
             self.cluster.record_event(Event(
                 object_kind=job.kind,
                 object_name=job.metadata.name,

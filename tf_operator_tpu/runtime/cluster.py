@@ -51,6 +51,18 @@ class EvictionBlocked(RuntimeError):
     """A voluntary eviction was refused because it would violate a PDB."""
 
 
+class TooManyRequests(RuntimeError):
+    """Apiserver throttling (HTTP 429 outside the eviction subresource).
+
+    Transient by definition — the server refused the request before
+    processing it, so any verb may be retried.  `retry_after` carries the
+    server's Retry-After hint (seconds) when it sent one."""
+
+    def __init__(self, message: str, retry_after: Optional[float] = None) -> None:
+        super().__init__(message)
+        self.retry_after = retry_after
+
+
 class AlreadyExists(ValueError):
     pass
 

@@ -1,0 +1,294 @@
+"""Deterministic fault injection for the control plane.
+
+The reference operator never tests its failure regime — client-go's retrying
+RESTClient and the informer relist machinery are trusted to absorb apiserver
+flakiness.  On preemptible TPU-VM slices that flakiness is the common case
+(arxiv 2011.03641 §5; VirtualFlow, arxiv 2009.09523), so this framework makes
+it a first-class, reproducible test input:
+
+  - FaultPlan: the schedule.  Either seeded (a private random.Random decides
+    per call whether and which fault fires) or scripted (an explicit list of
+    Fault-or-None decisions consumed in order).  Same seed + same call
+    sequence => same faults.
+  - FaultInjector: the tap.  KubeClient consults it once per request attempt
+    (for_request) and once per watch stream (for_watch); FaultyCluster
+    consults it per ClusterInterface call.  Every injected fault is appended
+    to `trace`, so a failing chaos run prints exactly what was injected and
+    replays from its seed or from FaultPlan(script=injector.replay_script()).
+  - FaultyCluster: a ClusterInterface delegate injecting the same faults at
+    the method-call boundary, for chaos over in-memory substrates where no
+    HTTP exists.
+
+Fault kinds (the `kind` strings are a contract with runtime/k8s.py's
+_apply_fault / stream_watch):
+
+  request: "reset" (connection reset; before_send picks the phase),
+           "throttle" (429 + Retry-After), "server_error" (500/503),
+           "latency" (stall, then proceed), "conflict" (409)
+  watch:   "watch_drop" (stream ends after N events), "gone" (410 Expired
+           => relist)
+"""
+from __future__ import annotations
+
+import random
+import threading
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Sequence, Tuple
+
+from .cluster import AlreadyExists, TooManyRequests
+
+FAULT_RESET = "reset"
+FAULT_THROTTLE = "throttle"
+FAULT_SERVER_ERROR = "server_error"
+FAULT_LATENCY = "latency"
+FAULT_CONFLICT = "conflict"
+FAULT_WATCH_DROP = "watch_drop"
+FAULT_GONE = "gone"
+
+REQUEST_KINDS: Tuple[str, ...] = (
+    FAULT_RESET, FAULT_THROTTLE, FAULT_SERVER_ERROR, FAULT_LATENCY,
+    FAULT_CONFLICT,
+)
+WATCH_KINDS: Tuple[str, ...] = (FAULT_WATCH_DROP, FAULT_GONE)
+
+
+@dataclass
+class Fault:
+    """One injected failure, fully parameterized (no randomness left)."""
+
+    kind: str
+    status: int = 0
+    retry_after: Optional[float] = None
+    latency: float = 0.0
+    before_send: bool = True
+    after_events: int = 1  # watch_drop: events served before the cut
+    message: str = "injected fault"
+
+
+@dataclass
+class FaultRecord:
+    """One trace entry: what fired, where, in injection order."""
+
+    seq: int
+    scope: str  # "request" | "watch" | "cluster"
+    op: str     # HTTP verb, or the ClusterInterface method name
+    path: str
+    fault: Fault
+
+
+class FaultPlan:
+    """Seeded-or-scripted fault schedule.
+
+    Seeded mode: each request consult fires a fault with probability `rate`
+    (watch consults: `watch_rate`), kind drawn uniformly from `kinds` /
+    `watch_kinds`, parameters drawn from the given ranges.  `max_faults`
+    caps total injections so an unlucky seed cannot starve a run forever.
+
+    Scripted mode: `script` entries are consumed in order, split by scope:
+    a plain Fault (or None) feeds request consults; a ("watch", Fault)
+    tuple feeds watch consults (("request"|"cluster", Fault) tuples are
+    accepted too — the shape FaultInjector.replay_script() produces), so a
+    replayed schedule lands at the same layer it originally fired at.
+    """
+
+    def __init__(self, seed: Optional[int] = None, rate: float = 0.1,
+                 watch_rate: float = 0.0,
+                 kinds: Sequence[str] = REQUEST_KINDS,
+                 watch_kinds: Sequence[str] = WATCH_KINDS,
+                 max_faults: Optional[int] = None,
+                 retry_after_range: Tuple[float, float] = (0.01, 0.05),
+                 latency_range: Tuple[float, float] = (0.005, 0.02),
+                 script: Optional[Sequence[Optional[Fault]]] = None) -> None:
+        self.seed = seed
+        self.rate = float(rate)
+        self.watch_rate = float(watch_rate)
+        self.kinds = tuple(kinds)
+        self.watch_kinds = tuple(watch_kinds)
+        self.max_faults = max_faults
+        self.retry_after_range = retry_after_range
+        self.latency_range = latency_range
+        self._script: Optional[List[Optional[Fault]]] = None
+        self._watch_script: Optional[List[Fault]] = None
+        if script is not None:
+            self._script, self._watch_script = [], []
+            for entry in script:
+                if isinstance(entry, tuple):
+                    scope, fault = entry
+                    if scope == "watch":
+                        self._watch_script.append(fault)
+                    else:
+                        self._script.append(fault)
+                else:
+                    self._script.append(entry)
+        self._rng = random.Random(seed)
+        self._injected = 0
+        self._lock = threading.Lock()
+
+    def _spent(self) -> bool:
+        return self.max_faults is not None and self._injected >= self.max_faults
+
+    def _make(self, kind: str) -> Fault:
+        if kind == FAULT_RESET:
+            return Fault(FAULT_RESET, before_send=self._rng.random() < 0.5,
+                         message="injected connection reset")
+        if kind == FAULT_THROTTLE:
+            return Fault(FAULT_THROTTLE, status=429,
+                         retry_after=round(
+                             self._rng.uniform(*self.retry_after_range), 4),
+                         message="injected apiserver throttle")
+        if kind == FAULT_SERVER_ERROR:
+            return Fault(FAULT_SERVER_ERROR,
+                         status=self._rng.choice((500, 503)),
+                         message="injected server error")
+        if kind == FAULT_LATENCY:
+            return Fault(FAULT_LATENCY,
+                         latency=self._rng.uniform(*self.latency_range),
+                         message="injected latency")
+        if kind == FAULT_CONFLICT:
+            return Fault(FAULT_CONFLICT, status=409,
+                         message="injected write conflict")
+        if kind == FAULT_WATCH_DROP:
+            return Fault(FAULT_WATCH_DROP,
+                         after_events=self._rng.randint(1, 5),
+                         message="injected watch drop")
+        if kind == FAULT_GONE:
+            return Fault(FAULT_GONE, status=410,
+                         message="injected 410: watch history expired")
+        raise ValueError(f"unknown fault kind {kind!r}")
+
+    def next_request_fault(self, op: str, path: str) -> Optional[Fault]:
+        with self._lock:
+            if self._script is not None:
+                fault = self._script.pop(0) if self._script else None
+            elif self._spent() or not self.kinds or self._rng.random() >= self.rate:
+                fault = None
+            else:
+                fault = self._make(self._rng.choice(self.kinds))
+            if fault is not None:
+                self._injected += 1
+            return fault
+
+    def next_watch_fault(self, path: str) -> Optional[Fault]:
+        with self._lock:
+            if self._watch_script is not None:
+                fault = (self._watch_script.pop(0)
+                         if self._watch_script else None)
+                if fault is not None:
+                    self._injected += 1
+                return fault
+            if (self._spent() or not self.watch_kinds
+                    or self._rng.random() >= self.watch_rate):
+                return None
+            fault = self._make(self._rng.choice(self.watch_kinds))
+            self._injected += 1
+            return fault
+
+
+class FaultInjector:
+    """The tap KubeClient/FaultyCluster consult; records every injection."""
+
+    def __init__(self, plan: FaultPlan) -> None:
+        self.plan = plan
+        self.trace: List[FaultRecord] = []
+        self._lock = threading.Lock()
+
+    def _record(self, scope: str, op: str, path: str,
+                fault: Optional[Fault]) -> Optional[Fault]:
+        if fault is not None:
+            with self._lock:
+                self.trace.append(FaultRecord(
+                    seq=len(self.trace), scope=scope, op=op, path=path,
+                    fault=fault,
+                ))
+        return fault
+
+    def for_request(self, method: str, path: str) -> Optional[Fault]:
+        return self._record(
+            "request", method, path,
+            self.plan.next_request_fault(method, path))
+
+    def for_watch(self, path: str) -> Optional[Fault]:
+        return self._record(
+            "watch", "WATCH", path, self.plan.next_watch_fault(path))
+
+    def for_cluster_call(self, method_name: str) -> Optional[Fault]:
+        return self._record(
+            "cluster", method_name, method_name,
+            self.plan.next_request_fault(method_name, method_name))
+
+    def describe(self) -> str:
+        """Human-readable trace for chaos failure reports — paste-able next
+        to the printed seed."""
+        lines = [f"seed={self.plan.seed} injected={len(self.trace)}"]
+        for rec in self.trace:
+            lines.append(
+                f"  #{rec.seq} [{rec.scope}] {rec.op} {rec.path}: "
+                f"{rec.fault.kind}"
+                + (f" status={rec.fault.status}" if rec.fault.status else "")
+            )
+        return "\n".join(lines)
+
+    def replay_script(self) -> List[Tuple[str, Fault]]:
+        """The injected faults in order as (scope, fault) entries — feed to
+        FaultPlan(script=...) to replay this exact schedule against the
+        same call sequence, each fault at the layer it originally hit."""
+        return [(rec.scope, rec.fault) for rec in self.trace]
+
+
+# ClusterInterface methods FaultyCluster intercepts.  Watches, events and
+# leases pass through: events are best-effort by contract, and faulting the
+# watch registration itself would blind the controller in a way no real
+# substrate failure does (streams fail mid-flight instead — a k8s-layer
+# concern, exercised via KubeClient's for_watch).
+_FAULTED_PREFIXES = (
+    "create_", "get_", "list_", "update_", "patch_", "delete_", "evict_",
+    "bind_",
+)
+_PASSTHROUGH = {"list_events"}
+_IDEMPOTENT_PREFIXES = ("get_", "list_", "delete_")
+
+
+class FaultyCluster:
+    """ClusterInterface delegate that injects plan faults per method call.
+
+    Chaos for in-memory/local substrates, where there is no HTTP seam: the
+    controller sees the same exception shapes the k8s backend would surface
+    after retry exhaustion (ConnectionError, TooManyRequests, RuntimeError,
+    AlreadyExists), so its requeue/expectation handling is exercised without
+    an apiserver.  Latency faults stall the call, then let it through.
+    """
+
+    def __init__(self, inner: Any, injector: FaultInjector,
+                 sleep=None) -> None:
+        import time as _time
+
+        self._inner = inner
+        self._injector = injector
+        self._sleep = sleep or _time.sleep
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._inner, name)
+        if (not callable(attr) or name in _PASSTHROUGH
+                or not name.startswith(_FAULTED_PREFIXES)):
+            return attr
+
+        def faulted(*args: Any, **kwargs: Any) -> Any:
+            fault = self._injector.for_cluster_call(name)
+            if fault is not None:
+                self._raise(fault, name)
+            return attr(*args, **kwargs)
+
+        return faulted
+
+    def _raise(self, fault: Fault, name: str) -> None:
+        if fault.kind == FAULT_LATENCY:
+            self._sleep(fault.latency)
+            return
+        if fault.kind == FAULT_RESET:
+            raise ConnectionResetError(f"{fault.message} ({name})")
+        if fault.kind == FAULT_THROTTLE:
+            raise TooManyRequests(f"{fault.message} ({name})",
+                                  retry_after=fault.retry_after)
+        if fault.kind == FAULT_CONFLICT:
+            raise AlreadyExists(f"{fault.message} ({name})")
+        raise RuntimeError(f"{fault.status}: {fault.message} ({name})")

@@ -26,6 +26,7 @@ from __future__ import annotations
 import datetime as _dt
 import json
 import os
+import random
 import ssl
 import threading
 import time
@@ -59,6 +60,7 @@ from .cluster import (
     EventType,
     EvictionBlocked,
     NotFound,
+    TooManyRequests,
     WatchHandler,
 )
 
@@ -387,6 +389,148 @@ class CRDNotInstalledError(RuntimeError):
     """The TPUJob CRD is absent from the cluster (startup check failed)."""
 
 
+class TransportError(Exception):
+    """A connection-level failure (reset, refused, truncated response).
+
+    `before_send` records whether the failure happened before any request
+    bytes reached the server — the property that makes retrying a write
+    safe.  `original` is the underlying OSError/HTTPException."""
+
+    def __init__(self, original: BaseException, before_send: bool) -> None:
+        super().__init__(str(original) or type(original).__name__)
+        self.original = original
+        self.before_send = before_send
+
+
+def _raise_for_status(status: int, path: str, message: str,
+                      retry_after: Optional[float] = None) -> None:
+    """Standard k8s error mapping for an HTTP error status.
+
+    429 is apiserver throttling (retryable TooManyRequests) everywhere
+    EXCEPT the eviction subresource, where it is the PDB's semantic answer
+    "the budget blocks this eviction" (EvictionBlocked, never retried)."""
+    if status == 404:
+        raise NotFound(message)
+    if status == 409:
+        raise AlreadyExists(message)
+    if status == 429:
+        if path.split("?", 1)[0].endswith("/eviction"):
+            raise EvictionBlocked(message)
+        raise TooManyRequests(message, retry_after=retry_after)
+    raise ApiError(status, message)
+
+
+def _parse_retry_after(header: Optional[str]) -> Optional[float]:
+    if not header:
+        return None
+    try:
+        return max(0.0, float(header))
+    except ValueError:
+        return None  # HTTP-date form: not worth supporting here
+
+
+class RetryPolicy:
+    """Transient-error retry schedule for KubeClient.request.
+
+    Exponential backoff with full jitter (delay ~ U[0, min(max_delay,
+    base_delay * 2^attempt)]), the AWS-recommended shape that decorrelates
+    a thundering herd of controllers retrying the same outage.  A 429's
+    Retry-After overrides the jittered delay — the server's explicit
+    instruction beats the client's guess.  Every request is bounded by a
+    per-call `deadline` (seconds) on top of `max_retries`.
+
+    Verb semantics (client-go's shouldRetry, adapted):
+      - GET/DELETE are idempotent: retried on connection failures at any
+        phase and on retryable statuses (429/500/502/503/504).
+      - POST/PUT/PATCH are retried on connection failures only when the
+        connection dropped BEFORE any request bytes were sent, plus on 429
+        (the apiserver throttles before processing, so nothing applied).
+    """
+
+    IDEMPOTENT = frozenset({"GET", "DELETE"})
+    RETRYABLE_STATUS = frozenset({429, 500, 502, 503, 504})
+
+    def __init__(self, max_retries: int = 5, base_delay: float = 0.1,
+                 max_delay: float = 5.0, deadline: float = 30.0,
+                 rng: Optional[random.Random] = None) -> None:
+        self.max_retries = int(max_retries)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.deadline = float(deadline)
+        self._rng = rng or random.Random()
+
+    def backoff(self, attempt: int, retry_after: Optional[float] = None) -> float:
+        if retry_after is not None:
+            return retry_after
+        cap = min(self.max_delay, self.base_delay * (2.0 ** attempt))
+        return self._rng.uniform(0.0, cap)
+
+    def should_retry(self, method: str, *, status: int = 0,
+                     connection_error: bool = False,
+                     before_send: bool = False) -> bool:
+        if connection_error:
+            return before_send or method in self.IDEMPOTENT
+        if status == 429:
+            return True
+        return status in self.RETRYABLE_STATUS and method in self.IDEMPOTENT
+
+
+# Consecutive giveups before the controller's degraded-mode backstop engages
+# (widened resync + one ClusterDegraded event; controller/controller.py), and
+# consecutive successes required to leave it again.
+DEGRADED_GIVEUP_THRESHOLD = 3
+DEGRADED_RECOVERY_THRESHOLD = 3
+
+
+class ClientHealth:
+    """Giveup tracker with hysteresis behind the degraded-mode backstop.
+
+    Entry: `threshold` consecutive giveups — a retryable failure that
+    exhausted its budget, or an unretryable connection failure.  Any
+    completed request (even one answered with an HTTP error — the apiserver
+    is alive and talking) resets that streak.
+
+    Exit: `recovery_threshold` consecutive successes.  A single success
+    must NOT end the episode: during a read-path outage the controller's
+    own writes (the ClusterDegraded event, status patches) still land, and
+    exiting on one of them would flap the episode — re-emitting the
+    once-per-episode event every few ticks."""
+
+    def __init__(self, threshold: int = DEGRADED_GIVEUP_THRESHOLD,
+                 recovery_threshold: int = DEGRADED_RECOVERY_THRESHOLD) -> None:
+        self.threshold = int(threshold)
+        self.recovery_threshold = int(recovery_threshold)
+        self._lock = threading.Lock()
+        self._consecutive_giveups = 0
+        self._consecutive_successes = 0
+        self._degraded = False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_giveups = 0
+            if self._degraded:
+                self._consecutive_successes += 1
+                if self._consecutive_successes >= self.recovery_threshold:
+                    self._degraded = False
+                    self._consecutive_successes = 0
+
+    def record_giveup(self) -> None:
+        with self._lock:
+            self._consecutive_successes = 0
+            self._consecutive_giveups += 1
+            if self._consecutive_giveups >= self.threshold:
+                self._degraded = True
+
+    @property
+    def consecutive_giveups(self) -> int:
+        with self._lock:
+            return self._consecutive_giveups
+
+    def degraded(self) -> bool:
+        with self._lock:
+            return self._degraded
+
+
 class KubeConfig:
     """Connection parameters for one apiserver."""
 
@@ -535,10 +679,21 @@ class KubeClient:
     open), JSON in/out, standard k8s error mapping."""
 
     def __init__(self, config: KubeConfig, timeout: float = 30.0,
-                 qps: float = 5.0, burst: int = 10) -> None:
+                 qps: float = 5.0, burst: int = 10,
+                 retry: Optional[RetryPolicy] = None,
+                 fault_injector: Optional[Any] = None,
+                 clock=time.monotonic, sleep=time.sleep) -> None:
         self.config = config
         self.timeout = timeout
-        self.limiter = TokenBucket(qps, burst)
+        self.retry = retry or RetryPolicy()
+        # Deterministic fault injection (runtime/faults.py FaultInjector);
+        # None in production.  Consulted per attempt in _request_once and
+        # per stream in stream_watch.
+        self.faults = fault_injector
+        self.health = ClientHealth()
+        self._clock = clock
+        self._sleep = sleep
+        self.limiter = TokenBucket(qps, burst, clock=clock, sleep=sleep)
         parts = urlsplit(config.host)
         self._scheme = parts.scheme or "https"
         self._netloc = parts.netloc or parts.path
@@ -578,34 +733,123 @@ class KubeClient:
                 body: Optional[dict] = None,
                 params: Optional[Dict[str, str]] = None,
                 content_type: str = "application/json",
-                raw: bool = False):
-        """JSON request/response; raw=True returns the body as text instead
-        (the pod log endpoint serves text/plain, not JSON)."""
+                raw: bool = False,
+                deadline: Optional[float] = None):
+        """JSON request/response with transient-error retries; raw=True
+        returns the body as text instead (the pod log endpoint serves
+        text/plain, not JSON).
+
+        Retry semantics live in RetryPolicy: exponential backoff with full
+        jitter, Retry-After honored on 429, writes only re-sent when the
+        connection failed before any bytes went out, everything bounded by
+        `deadline` seconds (default RetryPolicy.deadline).  Retries and
+        giveups are counted on tpujob_api_retries_total /
+        tpujob_api_giveups_total, and giveups feed the degraded-mode
+        backstop via ClientHealth."""
         if params:
             path = f"{path}?{urlencode(params)}"
+        payload = json.dumps(body) if body is not None else None
+        budget = self.retry.deadline if deadline is None else deadline
+        deadline_at = self._clock() + budget
+        attempt = 0
+        while True:
+            try:
+                result = self._request_once(method, path, payload,
+                                            content_type, raw)
+            except (NotFound, AlreadyExists, EvictionBlocked):
+                # The server answered; these are semantic outcomes, not
+                # transport trouble.
+                self.health.record_success()
+                raise
+            except TooManyRequests as err:
+                self._backoff_or_giveup(method, path, attempt, deadline_at,
+                                        err, retry_after=err.retry_after)
+            except ApiError as err:
+                if not self.retry.should_retry(method, status=err.code):
+                    self.health.record_success()
+                    raise
+                self._backoff_or_giveup(method, path, attempt, deadline_at, err)
+            except TransportError as err:
+                if not self.retry.should_retry(
+                        method, connection_error=True,
+                        before_send=err.before_send):
+                    # Unretryable by policy (write with bytes on the wire):
+                    # still a giveup — the control plane dropped us.
+                    metrics.api_giveups.labels().inc()
+                    self.health.record_giveup()
+                    raise err.original
+                self._backoff_or_giveup(method, path, attempt, deadline_at,
+                                        err.original)
+            else:
+                self.health.record_success()
+                return result
+            attempt += 1
+
+    def _backoff_or_giveup(self, method: str, path: str, attempt: int,
+                           deadline_at: float, err: BaseException,
+                           retry_after: Optional[float] = None) -> None:
+        """Sleep one backoff step, or raise `err` when the budget is gone."""
+        delay = self.retry.backoff(attempt, retry_after)
+        if attempt >= self.retry.max_retries or self._clock() + delay > deadline_at:
+            metrics.api_giveups.labels().inc()
+            self.health.record_giveup()
+            log.warning("giving up on %s %s after %d attempt(s): %s",
+                        method, path, attempt + 1, err)
+            raise err
+        metrics.api_retries.labels().inc()
+        log.debug("retrying %s %s in %.3fs (attempt %d): %s",
+                  method, path, delay, attempt + 1, err)
+        self._sleep(delay)
+
+    def _request_once(self, method: str, path: str, payload: Optional[str],
+                      content_type: str, raw: bool):
+        """One attempt: throttle, (optionally) inject a fault, do the HTTP
+        round-trip, map the status.  Connect is issued separately from send
+        so TransportError.before_send is accurate — the distinction that
+        makes write retries safe."""
         self._throttle()
+        if self.faults is not None:
+            fault = self.faults.for_request(method, path)
+            if fault is not None:
+                self._apply_fault(fault, method, path)
         conn = self._connect(self.timeout)
         try:
-            conn.request(
-                method, path,
-                body=json.dumps(body) if body is not None else None,
-                headers=self._headers(content_type),
-            )
-            resp = conn.getresponse()
-            payload = resp.read()
-            if resp.status == 404:
-                raise NotFound(_error_message(payload))
-            if resp.status == 409:
-                raise AlreadyExists(_error_message(payload))
-            if resp.status == 429:
-                raise EvictionBlocked(_error_message(payload))
+            try:
+                conn.connect()
+            except OSError as err:
+                raise TransportError(err, before_send=True) from err
+            try:
+                conn.request(method, path, body=payload,
+                             headers=self._headers(content_type))
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, HTTPException) as err:
+                raise TransportError(err, before_send=False) from err
             if resp.status >= 400:
-                raise ApiError(resp.status, _error_message(payload))
+                _raise_for_status(
+                    resp.status, path, _error_message(data),
+                    retry_after=_parse_retry_after(resp.getheader("Retry-After")),
+                )
             if raw:
-                return payload.decode(errors="replace")
-            return json.loads(payload) if payload else {}
+                return data.decode(errors="replace")
+            return json.loads(data) if data else {}
         finally:
             conn.close()
+
+    def _apply_fault(self, fault: Any, method: str, path: str) -> None:
+        """Translate an injected fault into the exact failure shape the real
+        transport produces, so the retry policy can't tell them apart."""
+        if fault.kind == "latency":
+            self._sleep(fault.latency)
+            return  # proceed with the real request after the stall
+        if fault.kind == "reset":
+            raise TransportError(
+                ConnectionResetError(
+                    f"injected connection reset ({method} {path})"),
+                before_send=fault.before_send,
+            )
+        _raise_for_status(fault.status, path, fault.message,
+                          retry_after=fault.retry_after)
 
     def stream_watch(self, path: str, params: Dict[str, str],
                      stop: threading.Event,
@@ -619,6 +863,17 @@ class KubeClient:
         # Establishing a watch costs one token (client-go throttles watch
         # creation the same way); the long-lived stream itself is free.
         self._throttle()
+        events_left: Optional[int] = None
+        if self.faults is not None:
+            fault = self.faults.for_watch(path)
+            if fault is not None:
+                if fault.kind == "gone":
+                    # 410 Expired: forces the owner's relist machinery.
+                    raise ApiError(410, fault.message)
+                if fault.kind == "watch_drop":
+                    # Serve a few events, then end the stream mid-flight as
+                    # a dying connection would.
+                    events_left = max(1, fault.after_events)
         conn = self._connect(None)  # watches are long-lived
         if conn_registry is not None:
             conn_registry.append(conn)
@@ -637,6 +892,10 @@ class KubeClient:
                     line, buf = buf.split(b"\n", 1)
                     if line.strip():
                         yield json.loads(line)
+                        if events_left is not None:
+                            events_left -= 1
+                            if events_left <= 0:
+                                return  # injected mid-stream drop
         finally:
             if conn_registry is not None:
                 try:
@@ -663,15 +922,22 @@ class KubernetesCluster(ClusterInterface):
     def __init__(self, config: Optional[KubeConfig] = None,
                  namespace: Optional[str] = None,
                  podgroup_api: str = PODGROUP_API,
-                 qps: float = 5.0, burst: int = 10) -> None:
+                 qps: float = 5.0, burst: int = 10,
+                 retry: Optional[RetryPolicy] = None,
+                 fault_injector: Optional[Any] = None) -> None:
         self.config = config or default_config()
-        self.client = KubeClient(self.config, qps=qps, burst=burst)
+        self._stop = threading.Event()
+        # Stop-aware backoff: retry sleeps return early once close() sets
+        # _stop, so watch threads mid-backoff wind down in milliseconds at
+        # teardown instead of sleeping out their full retry schedule.
+        self.client = KubeClient(self.config, qps=qps, burst=burst,
+                                 retry=retry, fault_injector=fault_injector,
+                                 sleep=self._stop.wait)
         # None = all namespaces (the reference's default, options.go:57-60)
         self.namespace = namespace
         self._job_handlers: List[WatchHandler] = []
         self._pod_handlers: List[WatchHandler] = []
         self._service_handlers: List[WatchHandler] = []
-        self._stop = threading.Event()
         self._watch_threads: Dict[str, threading.Thread] = {}
         self._watch_conns: List[Any] = []
         self._event_seq = 0
@@ -682,6 +948,13 @@ class KubernetesCluster(ClusterInterface):
         # (ns, name) pods already warned FailedScheduling this dry spell —
         # the 30s retry sweep must not mint a new Event object per attempt.
         self._sched_warned: set = set()
+
+    @property
+    def health(self) -> ClientHealth:
+        """Consecutive-giveup tracker the controller's degraded-mode
+        backstop polls (duck-typed: substrates without it are never
+        considered degraded)."""
+        return self.client.health
 
     # -- paths --
 
@@ -856,9 +1129,10 @@ class KubernetesCluster(ClusterInterface):
                 "PATCH", f"{path}/status", body=status_body,
                 content_type="application/merge-patch+json",
             )
-        except (ApiError, NotFound) as err:
+        except (ApiError, NotFound, TooManyRequests) as err:
             # Real clusters may deny pods/status to the operator (kubelet
-            # owns it); the metadata patch above already landed.
+            # owns it), or throttle it past the retry budget; the metadata
+            # patch above already landed.
             log.debug("pod status patch skipped: %s", err)
         return pod_from_k8s(raw)
 
@@ -1245,10 +1519,13 @@ class KubernetesCluster(ClusterInterface):
                         else:
                             known[obj_key] = obj
                         self._dispatch(handlers, mapping[etype], obj)
-            except (OSError, HTTPException, ApiError, NotFound, ValueError) as err:
+            except (OSError, HTTPException, ApiError, NotFound,
+                    TooManyRequests, ValueError) as err:
                 # HTTPException covers IncompleteRead/BadStatusLine from a
                 # mid-chunk truncated watch stream — without it the daemon
                 # thread dies and the controller silently stops seeing events.
+                # TooManyRequests: the relist GET exhausted its retry budget
+                # under sustained throttling; back off and try again.
                 if self._stop.is_set():
                     return
                 log.warning("watch %s error: %s; reconnecting", path, err)
@@ -1296,7 +1573,10 @@ class KubernetesCluster(ClusterInterface):
             try:
                 self.client.request("POST", path, body=body)
                 return True
-            except (AlreadyExists, ApiError):
+            except (AlreadyExists, ApiError, TooManyRequests):
+                # Lost/failed acquisition — including sustained throttling
+                # that exhausted the retry budget.  The elector loop retries;
+                # an escaped exception here would kill its thread silently.
                 return False
         spec = raw.get("spec") or {}
         current_holder = spec.get("holderIdentity", "")
@@ -1311,8 +1591,10 @@ class KubernetesCluster(ClusterInterface):
         try:
             self.client.request("PUT", f"{path}/{name}", body=body)
             return True
-        except (ApiError, AlreadyExists):
-            return False  # conflict: someone else renewed first
+        except (ApiError, AlreadyExists, TooManyRequests):
+            # Conflict (someone renewed first) or throttled past the retry
+            # budget: treat as not-acquired and let the elector loop retry.
+            return False
 
     def close(self) -> None:
         self._stop.set()

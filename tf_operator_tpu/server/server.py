@@ -177,6 +177,29 @@ class LeaderElector:
         self._stop.set()
 
 
+def startup_crd_check(cluster, log) -> None:
+    """Fail fast before any controller machinery starts when the CRD isn't
+    installed (ref: checkCRDExists, server.go:215-227).  Injected test
+    clusters without the check (in-memory/local) skip it.  Only a
+    confirmed-absent CRD is fatal: a transient apiserver hiccup or an RBAC
+    403 here must not crash-loop the operator when the reference would
+    start anyway (its checkCRDExists only treats IsNotFound as fatal) —
+    the watch/relist machinery retries once running."""
+    if not hasattr(cluster, "check_crd_exists"):
+        return
+    from ..runtime.k8s import CRDNotInstalledError
+
+    try:
+        cluster.check_crd_exists()
+    except CRDNotInstalledError as e:
+        log.error("CRD check failed: %s", e)
+        raise SystemExit(str(e))
+    except Exception as e:  # noqa: BLE001 — inconclusive, not absent
+        log.warning(
+            "CRD check inconclusive (%s); continuing startup — the "
+            "controller's watch machinery will retry", e)
+
+
 def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobController:
     """Build everything and run the controller (blocking).  `cluster` may be
     injected for tests (ref: app.Run, server.go:71-187)."""
@@ -231,15 +254,7 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
         else:
             cluster = InMemoryCluster()
 
-    # Fail fast before any controller machinery starts when the CRD isn't
-    # installed (ref: checkCRDExists, server.go:215-227).  Injected test
-    # clusters without the check (in-memory/local) skip it.
-    if hasattr(cluster, "check_crd_exists"):
-        try:
-            cluster.check_crd_exists()
-        except Exception as e:
-            log.error("CRD check failed: %s", e)
-            raise SystemExit(str(e))
+    startup_crd_check(cluster, log)
 
     config = ReconcilerConfig(
         reconciler_sync_loop_period=args.resync_period,

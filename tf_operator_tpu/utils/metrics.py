@@ -173,3 +173,15 @@ client_throttle_wait_seconds = REGISTRY.counter(
     "tpu_operator_client_throttle_wait_seconds_total",
     "Total seconds requests spent waiting on the client-side QPS limiter",
 )
+# Transient-error retry policy (runtime/k8s.py KubeClient.request): how often
+# requests were re-attempted after a retryable failure, and how often the
+# client exhausted its budget and surfaced the error.  A giveup burst feeds
+# the controller's degraded-mode backstop (ClusterDegraded).
+api_retries = REGISTRY.counter(
+    "tpujob_api_retries_total",
+    "Apiserver requests retried after a transient failure",
+)
+api_giveups = REGISTRY.counter(
+    "tpujob_api_giveups_total",
+    "Apiserver requests abandoned after exhausting the retry budget",
+)
