@@ -13,9 +13,15 @@ A summary line `RESULT tier=<tier> attempts=<n> status=<pass|fail>` plus
 `<junit-dir>/<tier>-summary.json` records what ran, what flaked, and what
 genuinely failed, so a flaky pass is visible rather than silent.
 
+The special tier `lint` runs the concurrency checker
+(`python -m tf_operator_tpu.analysis`, see docs/static-analysis.md) with no
+pytest or retry machinery — static findings are never flakes — emitting the
+same `RESULT tier=lint ... status=...` summary line and summary JSON.
+
 Usage:
     python build/run_tests.py --tier unit -m "not slow and not e2e and not tpu"
     python build/run_tests.py --tier local-e2e -m "slow and not e2e and not tpu" --retries 3
+    python build/run_tests.py --tier lint
 """
 from __future__ import annotations
 
@@ -71,6 +77,27 @@ def run_pytest(args_list: list[str], junit_path: str) -> int:
     return subprocess.call(cmd, cwd=ROOT)
 
 
+def run_lint_tier(junit_dir: str, paths: list[str]) -> int:
+    """One checker pass, no retries: `--tier lint`.  `paths` (relative to
+    --root) default to the repo's own package."""
+    targets = [p if os.path.isabs(p) else os.path.join(ROOT, p)
+               for p in paths] or [os.path.join(REPO, "tf_operator_tpu")]
+    env = dict(os.environ)
+    # the checker lives in this repo's package, wherever --root points
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    rc = 0
+    for target in targets:
+        cmd = [sys.executable, "-m", "tf_operator_tpu.analysis", target]
+        print("+", " ".join(cmd), flush=True)
+        rc |= subprocess.call(cmd, cwd=ROOT, env=env)
+    status = "pass" if rc == 0 else "fail"
+    with open(os.path.join(junit_dir, "lint-summary.json"), "w") as f:
+        json.dump({"tier": "lint", "attempts": 1, "status": status,
+                   "targets": targets}, f, indent=2)
+    print(f"RESULT tier=lint attempts=1 status={status}", flush=True)
+    return 0 if rc == 0 else 1
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--tier", required=True)
@@ -87,6 +114,9 @@ def main(argv=None) -> int:
     ROOT = os.path.abspath(args.root)
     junit_dir = os.path.join(ROOT, args.junit_dir)
     os.makedirs(junit_dir, exist_ok=True)
+
+    if args.tier == "lint":
+        return run_lint_tier(junit_dir, list(args.paths))
 
     base_args = list(args.paths) or ["tests/"]
     if args.marker:

@@ -1,0 +1,6 @@
+"""Known-bad fixture: rule `bare-lock` must fire exactly once (line 6)."""
+import threading
+
+
+def make():
+    return threading.Lock()

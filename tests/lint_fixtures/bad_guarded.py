@@ -1,0 +1,16 @@
+"""Known-bad fixture: rule `guarded-by` must fire exactly once (line 12):
+`_count` is declared guarded by `_lock` but `bump` mutates it lock-free."""
+from tf_operator_tpu.utils import locks
+
+
+class Counter:
+    def __init__(self):
+        self._lock = locks.new_lock("counter")
+        self._count = 0  # guarded-by: _lock
+
+    def bump(self):
+        self._count += 1
+
+    def bump_safely(self):
+        with self._lock:
+            self._count += 1

@@ -1,0 +1,19 @@
+"""Known-bad fixture: rule `guarded-by` must fire exactly once (line 15):
+`_drain` requires `_lock` but `tick` calls it without holding it."""
+from tf_operator_tpu.utils import locks
+
+
+class Sweeper:
+    def __init__(self):
+        self._lock = locks.new_lock("sweeper")
+        self._pending = []  # guarded-by: _lock
+
+    def _drain(self):  # requires-lock: _lock
+        self._pending.clear()
+
+    def tick(self):
+        self._drain()
+
+    def tick_safely(self):
+        with self._lock:
+            self._drain()

@@ -1,0 +1,9 @@
+"""Known-bad fixture: rule `thread-hygiene` must fire exactly once (line 7):
+the thread is anonymous and non-daemon."""
+import threading
+
+
+def spawn(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t

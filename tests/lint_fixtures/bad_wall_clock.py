@@ -1,0 +1,9 @@
+"""Known-bad fixture: rule `wall-clock` must fire exactly once (line 9).
+
+Checked with rel_path "runtime/bad_wall_clock.py" to land in lint scope.
+"""
+import time
+
+
+def stamp():
+    return time.time()

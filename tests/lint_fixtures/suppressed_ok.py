@@ -1,0 +1,24 @@
+"""Known-good fixture: every would-be finding carries a header-line
+`# lint: allow(<rule>)` suppression, so the checker must report nothing.
+
+Checked with rel_path "runtime/suppressed_ok.py" so the wall-clock rule is
+in scope too.
+"""
+import threading
+import time
+
+
+def make():
+    return threading.Lock()  # lint: allow(bare-lock) — fixture
+
+def stamp():
+    return time.time()  # lint: allow(wall-clock) — fixture
+
+def quietly(op):
+    try:
+        op()
+    except Exception:  # lint: allow(swallow) — fixture
+        pass
+
+def spawn(fn):
+    return threading.Thread(target=fn)  # lint: allow(thread-hygiene) — fixture

@@ -65,6 +65,42 @@ def test_hard_failure_exhausts_retries(tmp_path):
     assert any("test_always_red" in n for n in summary["failed"])
 
 
+def test_lint_tier_passes_on_clean_repo_package(tmp_path):
+    """`--tier lint` on the repo's own package: zero findings, pass line,
+    summary JSON — and no pytest/junit machinery involved."""
+    proc = subprocess.run(
+        [sys.executable, str(RUNNER), "--tier", "lint",
+         "--root", str(tmp_path), "--junit-dir", "junit"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "RESULT tier=lint attempts=1 status=pass" in proc.stdout
+    assert "0 finding(s)" in proc.stdout
+    summary = json.loads(
+        (tmp_path / "junit" / "lint-summary.json").read_text())
+    assert summary == {"tier": "lint", "attempts": 1, "status": "pass",
+                       "targets": [str(REPO / "tf_operator_tpu")]}
+    assert not (tmp_path / "junit" / "lint.xml").exists()
+
+
+def test_lint_tier_fails_on_findings(tmp_path):
+    pkg = tmp_path / "badpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text(
+        "import threading\n_lock = threading.Lock()\n")
+    proc = subprocess.run(
+        [sys.executable, str(RUNNER), "--tier", "lint",
+         "--root", str(tmp_path), "--junit-dir", "junit", "badpkg"],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "RESULT tier=lint attempts=1 status=fail" in proc.stdout
+    assert "[bare-lock]" in proc.stdout
+    summary = json.loads(
+        (tmp_path / "junit" / "lint-summary.json").read_text())
+    assert summary["status"] == "fail"
+
+
 def test_crashing_retry_is_not_a_pass(tmp_path, monkeypatch):
     """A retry attempt that dies without junit output must leave the tier
     failed — never silently flip outstanding failures to 'flaked'."""

@@ -14,6 +14,7 @@ from tf_operator_tpu.api.types import JobConditionType, ReplicaType
 from tf_operator_tpu.controller.controller import TPUJobController
 from tf_operator_tpu.runtime import conditions
 from tf_operator_tpu.runtime.cluster import InMemoryCluster
+from tf_operator_tpu.utils import locks
 
 from testutil import new_tpujob
 
@@ -36,29 +37,31 @@ def running_controller():
     controller.stop()
 
 
-def test_full_lifecycle(running_controller):
-    cluster, controller = running_controller
-    job = new_tpujob(worker=2, ps=1)
-    cluster.create_job(job)
-
-    # pods + services created by the reconcile loop
-    assert wait_for(lambda: len(cluster.list_pods()) == 3), "pods not created"
-    assert wait_for(lambda: len(cluster.list_services()) == 3), "services not created"
-
-    # drive to Running
+def drive_to_succeeded(cluster, expect_pods):
+    """The kubelet side of a happy-path run: wait for the reconcile loop's
+    pods, take everything to Running, finish the workers, wait for
+    Succeeded (worker-0 rule covers any remaining PS)."""
+    assert wait_for(lambda: len(cluster.list_pods()) == expect_pods), "pods not created"
     for pod in cluster.list_pods():
         cluster.set_pod_phase(pod.metadata.namespace, pod.metadata.name, PodPhase.RUNNING)
     assert wait_for(
         lambda: conditions.is_running(cluster.get_job("default", "test-tpujob").status)
     ), "job did not reach Running"
-
-    # workers finish → job Succeeded (worker-0 rule covers remaining PS)
     for pod in cluster.list_pods(selector={"replica-type": "worker"}):
         cluster.set_pod_phase(pod.metadata.namespace, pod.metadata.name,
                               PodPhase.SUCCEEDED, exit_code=0)
     assert wait_for(
         lambda: conditions.is_succeeded(cluster.get_job("default", "test-tpujob").status)
     ), "job did not reach Succeeded"
+
+
+def test_full_lifecycle(running_controller):
+    cluster, controller = running_controller
+    job = new_tpujob(worker=2, ps=1)
+    cluster.create_job(job)
+
+    assert wait_for(lambda: len(cluster.list_services()) == 3), "services not created"
+    drive_to_succeeded(cluster, expect_pods=3)
 
     # terminal cleanup: running PS pod deleted under default CleanPodPolicy
     assert wait_for(
@@ -100,3 +103,40 @@ def test_exit_code_restart_lifecycle(running_controller):
     ), "worker-0 was not restarted"
     stored = cluster.get_job("default", "test-tpujob")
     assert conditions.has_condition(stored.status, JobConditionType.RESTARTING)
+
+
+@pytest.fixture
+def instrumented_controller():
+    """Opt-in (deliberately NOT autouse — the wrappers add a Python frame
+    to every acquire, which the tier-1 budget does not want on every test):
+    builds cluster + controller inside `locks.instrumented()` so every lock
+    the control plane constructs reports acquisition order and hold times
+    to the registry."""
+    with locks.instrumented() as registry:
+        cluster = InMemoryCluster()
+        controller = TPUJobController(cluster, threadiness=2)
+    controller.start()
+    yield cluster, controller, registry
+    controller.stop()
+
+
+def test_lock_acquisition_order_is_consistent(instrumented_controller):
+    """Full job lifecycle under instrumented locks: the control plane must
+    exhibit a globally consistent lock order — no thread taking A then B
+    while another takes B then A (the deadlock precondition)."""
+    cluster, controller, registry = instrumented_controller
+    job = new_tpujob(worker=2, ps=1)
+    cluster.create_job(job)
+    drive_to_succeeded(cluster, expect_pods=3)
+
+    acquisitions = registry.acquisitions
+    assert acquisitions, "instrumentation never engaged"
+    names = {name for _seq, _thread, name in acquisitions}
+    # the run exercised the substrate and controller seams, not just one lock
+    assert "cluster" in names
+    assert "expectations" in names
+    inversions = registry.inversions()
+    assert not inversions, (
+        f"inconsistent lock acquisition order (A→B and B→A): {inversions}; "
+        f"nestings seen: {sorted(registry.pair_orders())}"
+    )

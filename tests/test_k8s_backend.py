@@ -200,6 +200,20 @@ def test_lease_leader_election(k8s):
     assert cluster.try_acquire_lease("op-lock", "holder-b", ttl=2.0)
 
 
+def test_lease_acquire_never_raises_on_transport_trouble(k8s, monkeypatch):
+    """An unreachable/refusing apiserver must read as not-acquired: an
+    escaped exception here kills the LeaderElector thread (a standby
+    crashes; a leader never reaches the graceful on_lost path)."""
+    server, cluster = k8s
+    for err in (ConnectionError("apiserver unreachable"),
+                OSError("socket closed")):
+        def raising_request(*args, _err=err, **kwargs):
+            raise _err
+
+        monkeypatch.setattr(cluster.client, "request", raising_request)
+        assert cluster.try_acquire_lease("op-lock", "holder-a", ttl=2.0) is False
+
+
 def test_eviction_respects_budget(k8s):
     server, cluster = k8s
     cluster.create_pod(Pod(

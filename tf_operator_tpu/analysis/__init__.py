@@ -1,0 +1,655 @@
+"""AST-based concurrency lint for the control plane.
+
+Zero-dependency static checker, run as:
+
+    python -m tf_operator_tpu.analysis tf_operator_tpu
+
+The control plane is a heavily threaded system (worker pools, resync loops,
+watch supervisors, gang-retry sweeps, leader election); these rules
+machine-check the concurrency discipline the code relies on:
+
+  bare-lock       no `threading.Lock()` / `RLock()` / `Condition()` outside
+                  the `utils/locks.py` factories — locks must be named (and
+                  instrumentable) via `new_lock` / `new_rlock` /
+                  `new_condition`.
+  wall-clock      no `time.time` inside `runtime/`, `controller/` or
+                  `server/` — timestamps go through `utils/clock.py`'s
+                  `clock.now()` (fakeable in tests), durations through
+                  `time.monotonic()`.
+  swallow         every `except Exception` (or bare `except`) handler must
+                  log or re-raise; silent swallows hide real failures.
+  thread-hygiene  `threading.Thread(...)` must pass an explicit `name=`
+                  (convention: `tpujob-<role>`) and `daemon=True`.
+  guarded-by      an attribute declared with a trailing
+                  `# guarded-by: <lockattr>` comment may only be mutated
+                  while `with self.<lockattr>:` is held (the declaring
+                  `__init__` is exempt).  Helpers annotated
+                  `# requires-lock: <lockattr>` on (or directly above)
+                  their `def` line count as holding the lock in their body,
+                  and their `self.<helper>()` call sites are checked.
+                  Module-level globals work the same with bare names.
+
+Suppression: `# lint: allow(<rule>)` on the statement's header line (the
+line the statement starts on; for an `except` clause, the `except` line).
+
+The checker is pure stdlib `ast` + source-line comment scanning, so it runs
+in milliseconds with no pytest machinery — see `build/run_tests.py --tier
+lint` and `tests/test_static_analysis.py` (which pins the package at zero
+findings and pins each rule's firing behavior on known-bad fixtures).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULE_BARE_LOCK = "bare-lock"
+RULE_WALL_CLOCK = "wall-clock"
+RULE_SWALLOW = "swallow"
+RULE_THREAD_HYGIENE = "thread-hygiene"
+RULE_GUARDED_BY = "guarded-by"
+# not a style rule: an unparseable file cannot be checked, which must
+# surface as a finding (exit 1), never as a traceback
+RULE_PARSE_ERROR = "parse-error"
+
+ALL_RULES = (
+    RULE_BARE_LOCK,
+    RULE_WALL_CLOCK,
+    RULE_SWALLOW,
+    RULE_THREAD_HYGIENE,
+    RULE_GUARDED_BY,
+    RULE_PARSE_ERROR,
+)
+
+# Subpackages (relative to the package root) where wall-clock reads are
+# banned.  train/ and ops/ are workload-side (they run inside pods, where
+# wall time is the point); utils/ hosts the clock seam itself.
+WALL_CLOCK_SCOPES = ("runtime", "controller", "server")
+
+# Primitive constructors the bare-lock rule owns.
+_LOCK_CTORS = {"Lock": "new_lock", "RLock": "new_rlock",
+               "Condition": "new_condition"}
+
+# Methods on a guarded attribute's value that mutate it in place.
+_MUTATORS = {
+    "add", "append", "appendleft", "clear", "discard", "extend", "insert",
+    "pop", "popitem", "popleft", "remove", "reverse", "setdefault", "sort",
+    "update",
+}
+
+# Handler calls that count as "logged it" for the swallow rule.
+_LOG_METHODS = {
+    "critical", "debug", "error", "exception", "info", "log", "log_message",
+    "print_exc", "warn", "warning",
+}
+
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([a-z><A-Z_-]+)\)")
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_REQUIRES_RE = re.compile(r"#\s*requires-lock:\s*([A-Za-z_][A-Za-z0-9_]*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # package-relative, forward slashes
+    line: int
+    message: str
+
+    def render(self, prefix: str = "") -> str:
+        where = f"{prefix}{self.path}" if prefix else self.path
+        return f"{where}:{self.line}: [{self.rule}] {self.message}"
+
+
+class _Comments:
+    """Per-line comment annotations: suppressions + lock declarations."""
+
+    def __init__(self, source: str) -> None:
+        self.allow: Dict[int, Set[str]] = {}
+        self.guarded: Dict[int, str] = {}
+        self.requires: Dict[int, str] = {}
+        for lineno, text in enumerate(source.splitlines(), start=1):
+            if "#" not in text:
+                continue
+            for match in _ALLOW_RE.finditer(text):
+                self.allow.setdefault(lineno, set()).add(match.group(1))
+            match = _GUARDED_RE.search(text)
+            if match:
+                self.guarded[lineno] = match.group(1)
+            match = _REQUIRES_RE.search(text)
+            if match:
+                self.requires[lineno] = match.group(1)
+
+    def allows(self, lineno: int, rule: str) -> bool:
+        return rule in self.allow.get(lineno, ())
+
+
+def _is_self_attr(node: ast.AST, attr: Optional[str] = None) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and (attr is None or node.attr == attr)
+    )
+
+
+class _FileChecker:
+    def __init__(self, source: str, rel_path: str) -> None:
+        self.rel_path = rel_path.replace(os.sep, "/")
+        self.comments = _Comments(source)
+        self.tree = ast.parse(source, filename=self.rel_path)
+        self.findings: List[Finding] = []
+        # any directory segment counts, so the rule stays armed when the
+        # lint root is a parent of the package (vendored/src layouts:
+        # "tf_operator_tpu/runtime/x.py" as well as "runtime/x.py")
+        self.in_wall_clock_scope = any(
+            part in WALL_CLOCK_SCOPES
+            for part in self.rel_path.split("/")[:-1]
+        )
+        # line -> header line of the innermost statement covering it, so a
+        # suppression on a multi-line statement's first line covers a
+        # violating expression that starts on a continuation line
+        self.stmt_header: Dict[int, int] = {}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt) or node.end_lineno is None:
+                continue
+            for line in range(node.lineno, node.end_lineno + 1):
+                prev = self.stmt_header.get(line)
+                if prev is None or node.lineno > prev:  # innermost wins
+                    self.stmt_header[line] = node.lineno
+        # Alias tracking so `import threading as th` / `from time import
+        # time` cannot evade the rules the literal spellings would trip.
+        # names bound by `from threading import Lock, Thread, ...` -> the
+        # original threading attr they denote
+        self.threading_names: Dict[str, str] = {}
+        # module aliases: names that denote the threading / time modules
+        self.threading_modules: Set[str] = {"threading"}
+        self.time_modules: Set[str] = {"time"}
+        # names bound to the time.time function itself
+        self.time_funcs: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name == "threading":
+                        self.threading_modules.add(alias.asname or alias.name)
+                    elif alias.name == "time":
+                        self.time_modules.add(alias.asname or alias.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "threading":
+                    for alias in node.names:
+                        self.threading_names[alias.asname or alias.name] = (
+                            alias.name
+                        )
+                elif node.module == "time":
+                    for alias in node.names:
+                        if alias.name == "time":
+                            self.time_funcs.add(alias.asname or alias.name)
+
+    # -- entry point ---------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                self._check_call(node)
+            elif isinstance(node, (ast.Attribute, ast.Name)):
+                self._check_wall_clock(node)
+            elif isinstance(node, ast.ExceptHandler):
+                self._check_swallow(node)
+        self._check_timers()
+        self._check_guarded_module(self.tree)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_guarded_class(node)
+        self.findings.sort(key=lambda f: (f.line, f.rule))
+        return self.findings
+
+    def _report(self, rule: str, node: ast.AST, message: str) -> None:
+        lineno = getattr(node, "lineno", 0)
+        header = self.stmt_header.get(lineno, lineno)
+        if (self.comments.allows(lineno, rule)
+                or self.comments.allows(header, rule)):
+            return
+        self.findings.append(Finding(rule, self.rel_path, lineno, message))
+
+    # -- bare-lock + thread-hygiene ------------------------------------
+
+    def _threading_ctor(self, func: ast.AST) -> Optional[str]:
+        """'Lock'/'RLock'/'Condition'/'Thread' when `func` names one from
+        the threading module (by any import spelling), else None."""
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in self.threading_modules):
+            return func.attr
+        if isinstance(func, ast.Name) and func.id in self.threading_names:
+            return self.threading_names[func.id]
+        return None
+
+    def _check_call(self, node: ast.Call) -> None:
+        ctor = self._threading_ctor(node.func)
+        if ctor in _LOCK_CTORS:
+            self._report(
+                RULE_BARE_LOCK, node,
+                f"bare threading.{ctor}(); use "
+                f"utils.locks.{_LOCK_CTORS[ctor]}(name) so the lock is "
+                "named and instrumentable",
+            )
+        elif ctor == "Thread":
+            kwargs = {kw.arg for kw in node.keywords if kw.arg}
+            missing = []
+            if "name" not in kwargs:
+                missing.append("an explicit name= (convention: "
+                               "\"tpujob-<role>\")")
+            daemon = next(
+                (kw.value for kw in node.keywords if kw.arg == "daemon"), None
+            )
+            if not (isinstance(daemon, ast.Constant) and daemon.value is True):
+                missing.append("daemon=True")
+            if missing:
+                self._report(
+                    RULE_THREAD_HYGIENE, node,
+                    "threading.Thread(...) missing " + " and ".join(missing),
+                )
+
+    @staticmethod
+    def _scope_walk(scope: ast.AST):
+        """All nodes of `scope` excluding nested function/class scopes."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _check_timers(self) -> None:
+        """threading.Timer is a Thread subclass whose constructor takes no
+        name=/daemon=; require the post-construction assignments instead
+        (`t.name = "tpujob-<role>"; t.daemon = True` in the same scope)."""
+        scopes = [self.tree] + [
+            n for n in ast.walk(self.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            timers: Dict[str, ast.Call] = {}   # var -> constructing call
+            assigned_calls: Set[int] = set()
+            named: Set[str] = set()
+            daemoned: Set[str] = set()
+            calls: List[ast.Call] = []
+            for node in self._scope_walk(scope):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    value = node.value
+                    if (isinstance(value, ast.Call)
+                            and self._threading_ctor(value.func) == "Timer"):
+                        for target in targets:
+                            if isinstance(target, ast.Name):
+                                timers[target.id] = value
+                                assigned_calls.add(id(value))
+                    for target in targets:
+                        if (isinstance(target, ast.Attribute)
+                                and isinstance(target.value, ast.Name)):
+                            if target.attr == "name":
+                                named.add(target.value.id)
+                            elif (target.attr == "daemon"
+                                  and isinstance(value, ast.Constant)
+                                  and value.value is True):
+                                daemoned.add(target.value.id)
+                elif isinstance(node, ast.Call):
+                    calls.append(node)
+            for var, call in timers.items():
+                missing = []
+                if var not in named:
+                    missing.append(f'{var}.name = "tpujob-<role>"')
+                if var not in daemoned:
+                    missing.append(f"{var}.daemon = True")
+                if missing:
+                    self._report(
+                        RULE_THREAD_HYGIENE, call,
+                        "threading.Timer(...) without " + " and ".join(missing)
+                        + " in the same scope",
+                    )
+            for call in calls:
+                if (self._threading_ctor(call.func) == "Timer"
+                        and id(call) not in assigned_calls):
+                    self._report(
+                        RULE_THREAD_HYGIENE, call,
+                        "threading.Timer(...) not bound to a variable; it "
+                        "cannot be named (t.name = \"tpujob-<role>\") or "
+                        "made a daemon",
+                    )
+
+    # -- wall-clock ----------------------------------------------------
+
+    def _check_wall_clock(self, node: ast.AST) -> None:
+        if not self.in_wall_clock_scope:
+            return
+        hit = (
+            isinstance(node, ast.Attribute)
+            and node.attr == "time"
+            and isinstance(node.value, ast.Name)
+            and node.value.id in self.time_modules
+        ) or (
+            isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id in self.time_funcs
+        )
+        if hit:
+            self._report(
+                RULE_WALL_CLOCK, node,
+                "time.time in control-plane code; use utils.clock.now() "
+                "for timestamps or time.monotonic() for durations",
+            )
+
+    # -- swallow -------------------------------------------------------
+
+    @staticmethod
+    def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:  # bare `except:` — broader still
+            return True
+        types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+                 else [handler.type])
+        return any(
+            isinstance(t, ast.Name) and t.id in ("Exception", "BaseException")
+            for t in types
+        )
+
+    def _check_swallow(self, handler: ast.ExceptHandler) -> None:
+        if not self._is_broad_handler(handler):
+            return
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _LOG_METHODS):
+                return
+        self._report(
+            RULE_SWALLOW, handler,
+            "broad except handler neither logs nor re-raises; silent "
+            "swallows hide real failures (log at debug or add "
+            "`# lint: allow(swallow)` with a justification)",
+        )
+
+    # -- guarded-by ----------------------------------------------------
+
+    def _check_guarded_class(self, cls: ast.ClassDef) -> None:
+        guarded: Dict[str, str] = {}   # attr -> lock attr
+        requires: Dict[str, str] = {}  # method name -> lock attr
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for method in methods:
+            lock = (self.comments.requires.get(method.lineno)
+                    or self.comments.requires.get(method.lineno - 1))
+            if lock:
+                requires[method.name] = lock
+            for node in ast.walk(method):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    lock = self.comments.guarded.get(node.lineno)
+                    if not lock:
+                        continue
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for target in targets:
+                        if _is_self_attr(target):
+                            guarded[target.attr] = lock
+        if not guarded and not requires:
+            return
+        for method in methods:
+            held: Set[str] = set()
+            if method.name in requires:
+                held = {requires[method.name]}
+            self._walk_guarded(
+                method, held, guarded, requires,
+                exempt=(method.name == "__init__"),
+                owner=f"{cls.name}.{method.name}",
+            )
+
+    def _check_guarded_module(self, tree: ast.Module) -> None:
+        """Module-level globals declared `name = ...  # guarded-by: lock`."""
+        guarded: Dict[str, str] = {}
+        declared_at: Dict[str, int] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                lock = self.comments.guarded.get(node.lineno)
+                if not lock:
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for target in targets:
+                    if isinstance(target, ast.Name):
+                        guarded[target.id] = lock
+                        declared_at[target.id] = node.lineno
+        if guarded:
+            self._walk_module_guarded(tree, set(), guarded, declared_at)
+
+    def _with_locks(self, node: ast.With) -> Set[str]:
+        """Lock names taken by a `with` statement: `self.<attr>` and bare
+        `Name` context expressions."""
+        held = set()
+        for item in node.items:
+            expr = item.context_expr
+            if _is_self_attr(expr):
+                held.add(expr.attr)
+            elif isinstance(expr, ast.Name):
+                held.add(expr.id)
+        return held
+
+    def _walk_guarded(self, node: ast.AST, held: Set[str],
+                      guarded: Dict[str, str], requires: Dict[str, str],
+                      exempt: bool, owner: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_held = held
+            if isinstance(child, ast.With):
+                child_held = held | self._with_locks(child)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                # A nested function body runs at some later time — locks
+                # held at definition prove nothing, and a closure defined
+                # in __init__ outlives __init__'s single-threaded grace
+                # period.  Checked with an empty held set and NO __init__
+                # exemption (suppress intentional cases).
+                self._walk_guarded(child, set(), guarded, requires,
+                                   exempt=False, owner=owner)
+                continue
+            if not exempt:
+                self._check_guarded_stmt(child, child_held, guarded, requires)
+            self._walk_guarded(child, child_held, guarded, requires,
+                               exempt, owner)
+
+    def _check_guarded_stmt(self, node: ast.AST, held: Set[str],
+                            guarded: Dict[str, str],
+                            requires: Dict[str, str]) -> None:
+        def flag(attr: str, via: ast.AST) -> None:
+            lock = guarded[attr]
+            if lock in held:
+                return
+            self._report(
+                RULE_GUARDED_BY, via,
+                f"self.{attr} (guarded-by {lock}) mutated outside "
+                f"`with self.{lock}:`",
+            )
+
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for target in targets:
+                attr = self._guarded_target_attr(target, guarded)
+                if attr is not None:
+                    flag(attr, node)
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                attr = self._guarded_target_attr(target, guarded)
+                if attr is not None:
+                    flag(attr, node)
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                return
+            # self.<attr>.<mutator>(...) on a guarded attribute
+            if (func.attr in _MUTATORS and _is_self_attr(func.value)
+                    and func.value.attr in guarded):
+                flag(func.value.attr, node)
+            # self.<helper>() where helper is `# requires-lock:` annotated
+            elif (func.attr in requires
+                  and isinstance(func.value, ast.Name)
+                  and func.value.id == "self"
+                  and requires[func.attr] not in held):
+                lock = requires[func.attr]
+                self._report(
+                    RULE_GUARDED_BY, node,
+                    f"call to self.{func.attr}() (requires-lock {lock}) "
+                    f"outside `with self.{lock}:`",
+                )
+
+    @staticmethod
+    def _guarded_target_attr(target: ast.AST,
+                             guarded: Dict[str, str]) -> Optional[str]:
+        """Guarded attr name when `target` writes self.<attr> or
+        self.<attr>[...]; else None."""
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        if isinstance(target, ast.Attribute) and _is_self_attr(target):
+            if target.attr in guarded:
+                return target.attr
+        return None
+
+    def _walk_module_guarded(self, node: ast.AST, held: Set[str],
+                             guarded: Dict[str, str],
+                             declared_at: Dict[str, int]) -> None:
+        def flag(name: str, via: ast.AST) -> None:
+            self._report(
+                RULE_GUARDED_BY, via,
+                f"module global {name} (guarded-by {guarded[name]}) "
+                f"mutated outside `with {guarded[name]}:`",
+            )
+
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                # class bodies bind class attributes, and methods use the
+                # self-attr rule — bare names there are not module globals
+                continue
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # Inside a function: a bare-name ASSIGNMENT mutates the
+                # global only under `global`; without it the name becomes a
+                # local for the whole function, so in-place mutator calls
+                # on such a name target the local too.  Names never bound
+                # locally stay checkable for in-place mutation (no `global`
+                # needed for `_pending.append(v)`).  Locks held at the
+                # definition site prove nothing at call time.
+                declared_global = {
+                    name
+                    for g in ast.walk(child) if isinstance(g, ast.Global)
+                    for name in g.names
+                }
+                locally_bound = {
+                    target.id
+                    for n in ast.walk(child)
+                    if isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign))
+                    for target in (n.targets if isinstance(n, ast.Assign)
+                                   else [n.target])
+                    if isinstance(target, ast.Name)
+                } - declared_global
+                scoped = {k: v for k, v in guarded.items()
+                          if k in declared_global or k not in locally_bound}
+                if scoped:
+                    self._walk_module_guarded(child, set(), scoped,
+                                              declared_at)
+                continue
+            child_held = held
+            if isinstance(child, ast.With):
+                child_held = held | self._with_locks(child)
+            if isinstance(child, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (child.targets if isinstance(child, ast.Assign)
+                           else [child.target])
+                for target in targets:
+                    name = None
+                    if isinstance(target, ast.Name):
+                        if declared_at.get(target.id) == child.lineno:
+                            continue  # the declaring assignment itself
+                        name = target.id
+                    elif (isinstance(target, ast.Subscript)
+                          and isinstance(target.value, ast.Name)):
+                        name = target.value.id
+                    if (name in guarded
+                            and guarded[name] not in child_held):
+                        flag(name, child)
+            elif isinstance(child, ast.Call):
+                func = child.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in _MUTATORS
+                        and isinstance(func.value, ast.Name)
+                        and func.value.id in guarded
+                        and guarded[func.value.id] not in child_held):
+                    flag(func.value.id, child)
+            self._walk_module_guarded(child, child_held, guarded, declared_at)
+
+
+def check_source(source: str, rel_path: str) -> List[Finding]:
+    """Lint one module's source.  `rel_path` is the path relative to the
+    package root (it decides wall-clock scoping, e.g. "runtime/x.py").
+    An unparseable module yields a single `parse-error` finding."""
+    try:
+        return _FileChecker(source, rel_path).run()
+    except SyntaxError as err:
+        return [Finding(
+            RULE_PARSE_ERROR, rel_path.replace(os.sep, "/"),
+            err.lineno or 0, f"cannot parse module: {err.msg}",
+        )]
+
+
+def check_file(path: str, rel_path: Optional[str] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return check_source(source, rel_path or os.path.basename(path))
+
+
+def check_package(root: str) -> List[Finding]:
+    """Lint every .py under the package directory `root`."""
+    findings: List[Finding] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for filename in sorted(filenames):
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            rel = os.path.relpath(path, root)
+            findings.extend(check_file(path, rel))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def resolve_package_dir(spec: str) -> Tuple[str, str]:
+    """(directory, display-prefix) for a path or an importable package."""
+    if os.path.isdir(spec):
+        return spec, spec.rstrip("/\\") + "/"
+    import importlib.util
+
+    found = importlib.util.find_spec(spec)
+    if found is None or not found.submodule_search_locations:
+        raise SystemExit(f"cannot resolve package or directory: {spec!r}")
+    root = list(found.submodule_search_locations)[0]
+    return root, spec.replace(".", "/") + "/"
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m tf_operator_tpu.analysis",
+        description="concurrency lint (see docs/static-analysis.md)",
+    )
+    parser.add_argument("package", nargs="?", default="tf_operator_tpu",
+                        help="package name or directory to lint "
+                             "(default: tf_operator_tpu)")
+    args = parser.parse_args(argv)
+
+    root, prefix = resolve_package_dir(args.package)
+    findings = check_package(root)
+    for finding in findings:
+        print(finding.render(prefix))
+    print(f"{len(findings)} finding(s) in {prefix.rstrip('/')}")
+    return 1 if findings else 0

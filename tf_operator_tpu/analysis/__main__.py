@@ -1,0 +1,7 @@
+"""`python -m tf_operator_tpu.analysis <package>` entry point."""
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

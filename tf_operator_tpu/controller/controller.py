@@ -35,6 +35,7 @@ from ..runtime.reconciler import (
     ReconcilerConfig,
 )
 from ..runtime.workqueue import RateLimitingQueue, ShutDown
+from ..utils import locks
 from ..utils import logging as tpulog
 from ..utils import metrics
 from . import status as status_engine
@@ -82,8 +83,8 @@ class TPUJobController(JobPlugin):
         # job keys already warned about disabled multislice emission;
         # check-and-add under _warned_lock so threadiness>1 emits exactly
         # one MultisliceDisabled event per job
-        self._multislice_warned: set = set()
-        self._warned_lock = threading.Lock()
+        self._multislice_warned: set = set()  # guarded-by: _warned_lock
+        self._warned_lock = locks.new_lock("multislice-warned")
         # degraded-mode backstop state (see _check_degraded)
         self._degraded = False
         self.resync_period_current = (
@@ -291,13 +292,13 @@ class TPUJobController(JobPlugin):
     def sync_job(self, key: str) -> bool:
         """One reconcile pass for `key` (ref: syncTFJob, controller.go:290-334).
         Returns True if a reconcile ran (expectations satisfied)."""
-        start = time.time()
+        start = time.monotonic()
         try:
             return self._sync_job(key)
         finally:
             # Per-sync latency log (ref: controller.go:291-295).
             tpulog.logger_for_key(key).debug(
-                "finished syncing tpujob (%.1f ms)", (time.time() - start) * 1e3
+                "finished syncing tpujob (%.1f ms)", (time.monotonic() - start) * 1e3
             )
 
     def _sync_job(self, key: str) -> bool:

@@ -14,7 +14,6 @@ most test-covered contract in the reference (~30 unit cases + 3 E2E suites):
 """
 from __future__ import annotations
 
-import time
 from typing import Dict
 
 from ..api.core import Event, PodPhase
@@ -36,7 +35,7 @@ from ..runtime.reconciler import (
     get_container_exit_code,
     get_pod_slices,
 )
-from ..utils import metrics
+from ..utils import clock, metrics
 
 JOB_RUNNING_REASON = "TPUJobRunning"
 JOB_SUCCEEDED_REASON = "TPUJobSucceeded"
@@ -87,7 +86,7 @@ def update_job_status(
     worker0_completed = is_worker0_completed(job, pods)
 
     if status.start_time is None:
-        status.start_time = time.time()
+        status.start_time = clock.now()
         deadline = job.spec.run_policy.active_deadline_seconds
         if deadline is not None and on_start_time_set is not None:
             on_start_time_set(deadline)
@@ -154,7 +153,7 @@ def update_job_status(
                         )
                     )
                 if status.completion_time is None:
-                    status.completion_time = time.time()
+                    status.completion_time = clock.now()
                 newly_failed = not conditions.is_failed(status)
                 conditions.update_job_conditions(
                     status, JobConditionType.FAILED, JOB_FAILED_REASON, msg
@@ -177,7 +176,7 @@ def _mark_succeeded(job: TPUJob, status: JobStatus, record_event) -> None:
             )
         )
     if status.completion_time is None:
-        status.completion_time = time.time()
+        status.completion_time = clock.now()
     newly_succeeded = not conditions.is_succeeded(status)
     conditions.update_job_conditions(
         status, JobConditionType.SUCCEEDED, JOB_SUCCEEDED_REASON, msg

@@ -24,14 +24,13 @@ informer delivery order for a single writer.
 from __future__ import annotations
 
 import itertools
-import threading
-import time
 from enum import Enum
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..api import constants
 from ..api.core import Event, ObjectMeta, Pod, PodDisruptionBudget, PodGroup, Service
 from ..api.types import JobStatus, TPUJob
+from ..utils import clock, locks
 
 
 class EventType(str, Enum):
@@ -138,15 +137,15 @@ class InMemoryCluster(ClusterInterface):
     """Thread-safe in-memory substrate with synchronous watch delivery."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
-        self._jobs: Dict[Tuple[str, str], TPUJob] = {}
-        self._pods: Dict[Tuple[str, str], Pod] = {}
-        self._services: Dict[Tuple[str, str], Service] = {}
-        self._podgroups: Dict[Tuple[str, str], PodGroup] = {}
-        self._pdbs: Dict[Tuple[str, str], PodDisruptionBudget] = {}
-        self._gang_scheduler_names: set = set()
-        self._events: List[Event] = []
-        self._leases: Dict[str, Tuple[str, float]] = {}  # name -> (holder, expiry)
+        self._lock = locks.new_rlock("cluster")
+        self._jobs: Dict[Tuple[str, str], TPUJob] = {}  # guarded-by: _lock
+        self._pods: Dict[Tuple[str, str], Pod] = {}  # guarded-by: _lock
+        self._services: Dict[Tuple[str, str], Service] = {}  # guarded-by: _lock
+        self._podgroups: Dict[Tuple[str, str], PodGroup] = {}  # guarded-by: _lock
+        self._pdbs: Dict[Tuple[str, str], PodDisruptionBudget] = {}  # guarded-by: _lock
+        self._gang_scheduler_names: set = set()  # guarded-by: _lock
+        self._events: List[Event] = []  # guarded-by: _lock
+        self._leases: Dict[str, Tuple[str, float]] = {}  # guarded-by: _lock (name -> holder, expiry)
         self._job_handlers: List[WatchHandler] = []
         self._pod_handlers: List[WatchHandler] = []
         self._svc_handlers: List[WatchHandler] = []
@@ -442,7 +441,7 @@ class InMemoryCluster(ClusterInterface):
 
     def try_acquire_lease(self, name: str, holder: str, ttl: float) -> bool:
         """EndpointsLock analogue (ref: cmd/tf-operator.v1/app/server.go:159-184)."""
-        now = time.time()
+        now = clock.now()
         with self._lock:
             current = self._leases.get(name)
             if current is None or current[1] < now or current[0] == holder:
@@ -453,7 +452,7 @@ class InMemoryCluster(ClusterInterface):
     def lease_holder(self, name: str) -> Optional[str]:
         with self._lock:
             current = self._leases.get(name)
-            if current is None or current[1] < time.time():
+            if current is None or current[1] < clock.now():
                 return None
             return current[0]
 
@@ -467,7 +466,7 @@ class InMemoryCluster(ClusterInterface):
             pod = self.get_pod(namespace, name)
             pod.status.phase = phase
             if pod.status.start_time is None and phase != PodPhase.PENDING:
-                pod.status.start_time = time.time()
+                pod.status.start_time = clock.now()
             if not pod.status.container_statuses:
                 cname = pod.spec.containers[0].name if pod.spec.containers else "tensorflow"
                 pod.status.container_statuses = [ContainerStatus(name=cname)]

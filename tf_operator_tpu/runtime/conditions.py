@@ -10,16 +10,16 @@ Behavioral contract of the reference's status helpers
 """
 from __future__ import annotations
 
-import time
 from typing import List, Optional
 
 from ..api.types import JobCondition, JobConditionType, JobStatus
+from ..utils import clock
 
 
 def new_condition(
     ctype: JobConditionType, reason: str, message: str, status: bool = True
 ) -> JobCondition:
-    now = time.time()
+    now = clock.now()
     return JobCondition(
         type=ctype,
         status=status,

@@ -10,12 +10,12 @@ Behavioral contract of the reference's control package
 """
 from __future__ import annotations
 
-import threading
 from typing import List
 
 from ..api import constants
 from ..api.core import Event, Pod, Service
 from ..api.types import TPUJob
+from ..utils import locks
 from .cluster import ClusterInterface
 
 
@@ -87,7 +87,7 @@ class FakePodControl(PodControlInterface):
     """Records intended effects (ref: control/pod_control.go FakePodControl)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = locks.new_lock("fake-pod-control")
         self.pods: List[Pod] = []
         self.deleted_pod_names: List[str] = []
         self.create_error: Exception | None = None
@@ -109,7 +109,7 @@ class FakePodControl(PodControlInterface):
 
 class FakeServiceControl(ServiceControlInterface):
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = locks.new_lock("fake-service-control")
         self.services: List[Service] = []
         self.deleted_service_names: List[str] = []
 

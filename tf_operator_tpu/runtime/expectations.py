@@ -15,9 +15,10 @@ duplicates (SURVEY.md §7 "hard parts").
 """
 from __future__ import annotations
 
-import threading
 import time
 from dataclasses import dataclass, field
+
+from ..utils import locks
 
 EXPECTATION_TIMEOUT_SECONDS = 5 * 60.0  # ref: expectation.go:24
 
@@ -31,19 +32,21 @@ def expectation_key(job_key: str, replica_type: str, kind: str) -> str:
 class _Entry:
     adds: int = 0
     dels: int = 0
-    timestamp: float = field(default_factory=time.time)
+    # monotonic, not wall-clock: the TTL is a duration measurement and
+    # must not jump with NTP steps (and stays out of clock.now()'s remit)
+    timestamp: float = field(default_factory=time.monotonic)
 
     def fulfilled(self) -> bool:
         return self.adds <= 0 and self.dels <= 0
 
     def expired(self) -> bool:
-        return time.time() - self.timestamp > EXPECTATION_TIMEOUT_SECONDS
+        return time.monotonic() - self.timestamp > EXPECTATION_TIMEOUT_SECONDS
 
 
 class Expectations:
     def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._entries: dict[str, _Entry] = {}
+        self._lock = locks.new_lock("expectations")
+        self._entries: dict[str, _Entry] = {}  # guarded-by: _lock
 
     def expect_creations(self, key: str, count: int) -> None:
         self._set(key, adds=count, dels=0)

@@ -31,10 +31,10 @@ _apply_fault / stream_watch):
 from __future__ import annotations
 
 import random
-import threading
 from dataclasses import dataclass, field
 from typing import Any, List, Optional, Sequence, Tuple
 
+from ..utils import locks
 from .cluster import AlreadyExists, TooManyRequests
 
 FAULT_RESET = "reset"
@@ -121,8 +121,8 @@ class FaultPlan:
                 else:
                     self._script.append(entry)
         self._rng = random.Random(seed)
-        self._injected = 0
-        self._lock = threading.Lock()
+        self._injected = 0  # guarded-by: _lock
+        self._lock = locks.new_lock("fault-plan")
 
     def _spent(self) -> bool:
         return self.max_faults is not None and self._injected >= self.max_faults
@@ -189,8 +189,8 @@ class FaultInjector:
 
     def __init__(self, plan: FaultPlan) -> None:
         self.plan = plan
-        self.trace: List[FaultRecord] = []
-        self._lock = threading.Lock()
+        self.trace: List[FaultRecord] = []  # guarded-by: _lock
+        self._lock = locks.new_lock("fault-trace")
 
     def _record(self, scope: str, op: str, path: str,
                 fault: Optional[Fault]) -> Optional[Fault]:
@@ -245,7 +245,6 @@ _FAULTED_PREFIXES = (
     "bind_",
 )
 _PASSTHROUGH = {"list_events"}
-_IDEMPOTENT_PREFIXES = ("get_", "list_", "delete_")
 
 
 class FaultyCluster:

@@ -52,6 +52,7 @@ from ..api.core import (
     ServicePort,
 )
 from ..api.types import JobStatus, TPUJob
+from ..utils import clock, locks
 from ..utils import logging as tpulog
 from ..utils import metrics
 from .cluster import (
@@ -371,7 +372,7 @@ def event_from_k8s(raw: Dict[str, Any]) -> Event:
         event_type=raw.get("type", "Normal"),
         reason=raw.get("reason", ""),
         message=raw.get("message", ""),
-        timestamp=from_rfc3339(raw.get("lastTimestamp")) or time.time(),
+        timestamp=from_rfc3339(raw.get("lastTimestamp")) or clock.now(),
     )
 
 
@@ -500,10 +501,10 @@ class ClientHealth:
                  recovery_threshold: int = DEGRADED_RECOVERY_THRESHOLD) -> None:
         self.threshold = int(threshold)
         self.recovery_threshold = int(recovery_threshold)
-        self._lock = threading.Lock()
-        self._consecutive_giveups = 0
-        self._consecutive_successes = 0
-        self._degraded = False
+        self._lock = locks.new_lock("client-health")
+        self._consecutive_giveups = 0  # guarded-by: _lock
+        self._consecutive_successes = 0  # guarded-by: _lock
+        self._degraded = False  # guarded-by: _lock
 
     def record_success(self) -> None:
         with self._lock:
@@ -643,7 +644,7 @@ class TokenBucket:
         self._clock = clock
         self._sleep = sleep
         self._last = clock()
-        self._lock = threading.Lock()
+        self._lock = locks.new_lock("token-bucket")
         # observability: how often/long callers were actually held back
         self.wait_count = 0
         self.wait_seconds = 0.0
@@ -1555,45 +1556,70 @@ class KubernetesCluster(ClusterInterface):
         EndpointsLock semantics, server.go:53-58,159-184)."""
         namespace = self._ns(None)
         path = f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
-        now = time.time()
-        body = {
-            "apiVersion": "coordination.k8s.io/v1",
-            "kind": "Lease",
-            "metadata": {"name": name, "namespace": namespace},
-            "spec": {
-                "holderIdentity": holder,
-                "leaseDurationSeconds": int(ttl),
-                "renewTime": to_rfc3339(now).replace("Z", ".000000Z"),
-                "acquireTime": to_rfc3339(now).replace("Z", ".000000Z"),
-            },
-        }
+        # Lease calls must not ride the default ~30s retry budget: a renew
+        # blocked past the lease duration keeps a deposed leader reconciling
+        # (split brain) instead of letting the elector observe the loss on
+        # its next cycle.  Bound every attempt well inside the ttl.
+        deadline = ttl / 3.0
+
+        def stamped_body() -> dict:
+            # Stamped at write time, not method entry: peers compute expiry
+            # from the LANDED renewTime, so a stamp taken before the
+            # (possibly retrying) GET would hand back the margin the
+            # per-call deadline above buys.
+            now = clock.now()
+            return {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": name, "namespace": namespace},
+                "spec": {
+                    "holderIdentity": holder,
+                    "leaseDurationSeconds": int(ttl),
+                    "renewTime": to_rfc3339(now).replace("Z", ".000000Z"),
+                    "acquireTime": to_rfc3339(now).replace("Z", ".000000Z"),
+                },
+            }
+
         try:
-            raw = self.client.request("GET", f"{path}/{name}")
+            raw = self.client.request("GET", f"{path}/{name}",
+                                      deadline=deadline)
         except NotFound:
             try:
-                self.client.request("POST", path, body=body)
+                self.client.request("POST", path, body=stamped_body(),
+                                    deadline=deadline)
                 return True
-            except (AlreadyExists, ApiError, TooManyRequests):
+            except (AlreadyExists, ApiError, TooManyRequests,
+                    OSError, HTTPException):
                 # Lost/failed acquisition — including sustained throttling
                 # that exhausted the retry budget.  The elector loop retries;
                 # an escaped exception here would kill its thread silently.
                 return False
+        except (ApiError, TooManyRequests, OSError, HTTPException):
+            # Unreachable/refusing apiserver past the (short) lease retry
+            # budget: report not-acquired.  A standby keeps polling; a
+            # leader reaches on_lost gracefully instead of dying mid-renew
+            # with a traceback.
+            return False
         spec = raw.get("spec") or {}
         current_holder = spec.get("holderIdentity", "")
         renew = from_rfc3339((spec.get("renewTime") or "").split(".")[0] + "Z")
         duration = float(spec.get("leaseDurationSeconds") or ttl)
-        expired = renew is None or (now - renew) > duration
+        expired = renew is None or (clock.now() - renew) > duration
         if current_holder and current_holder != holder and not expired:
             return False
+        body = stamped_body()
         body["metadata"]["resourceVersion"] = (raw.get("metadata") or {}).get(
             "resourceVersion", ""
         )
         try:
-            self.client.request("PUT", f"{path}/{name}", body=body)
+            self.client.request("PUT", f"{path}/{name}", body=body,
+                                deadline=deadline)
             return True
-        except (ApiError, AlreadyExists, TooManyRequests):
-            # Conflict (someone renewed first) or throttled past the retry
-            # budget: treat as not-acquired and let the elector loop retry.
+        except (ApiError, AlreadyExists, NotFound, TooManyRequests,
+                OSError, HTTPException):
+            # Conflict (someone renewed first), lease deleted under us,
+            # throttled past the retry budget, or transport trouble: treat
+            # as not-acquired and let the elector loop retry.
             return False
 
     def close(self) -> None:

@@ -27,6 +27,7 @@ from typing import Dict, Optional, Tuple
 from ..api import constants
 from ..api.core import ContainerStatus, Pod, PodPhase
 from ..api.types import ReplicaType, TPUJob
+from ..utils import clock, locks
 from ..utils import logging as tpulog
 from .cluster import EventType, InMemoryCluster
 
@@ -75,9 +76,10 @@ class LocalProcessCluster(InMemoryCluster):
         # image ref, falling back to the tagless name.
         self._image_entrypoints: Dict[str, Tuple[list, list]] = {}
         self._procs: Dict[Tuple[str, str], subprocess.Popen] = {}
-        self._ports: Dict[str, int] = {}
-        self._port_lock = threading.Lock()
-        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True)
+        self._ports: Dict[str, int] = {}  # guarded-by: _port_lock
+        self._port_lock = locks.new_lock("local-ports")
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         name="tpujob-monitor", daemon=True)
         self._monitor_started = False
         self._closed = False
 
@@ -198,7 +200,7 @@ class LocalProcessCluster(InMemoryCluster):
     def _transition(self, pod: Pod, phase: PodPhase, exit_code: Optional[int] = None) -> None:
         pod.status.phase = phase
         if pod.status.start_time is None and phase != PodPhase.PENDING:
-            pod.status.start_time = time.time()
+            pod.status.start_time = clock.now()
         cname = pod.spec.containers[0].name if pod.spec.containers else "tensorflow"
         if not pod.status.container_statuses:
             pod.status.container_statuses = [ContainerStatus(name=cname)]

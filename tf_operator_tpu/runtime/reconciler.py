@@ -19,7 +19,6 @@ of the 15-method ControllerInterface (vendor/.../apis/common/v1/interface.go:10-
 """
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -43,6 +42,7 @@ from ..api.types import (
     TPUJob,
     TPUJobSpec,
 )
+from ..utils import clock
 from ..utils import logging as tpulog
 from ..utils import metrics
 from . import conditions
@@ -274,7 +274,7 @@ class JobReconciler:
     def reconcile_job(self, job: TPUJob) -> ReconcileResult:
         log = tpulog.logger_for_job(job)
         old_status = _snapshot_status(job.status)
-        job.status.last_reconcile_time = time.time()
+        job.status.last_reconcile_time = clock.now()
         result = ReconcileResult()
 
         pods = self.get_pods_for_job(job)
@@ -332,7 +332,7 @@ class JobReconciler:
                 job.status, conditions.JobConditionType.FAILED, failure_reason, failure_message
             )
             if job.status.completion_time is None:
-                job.status.completion_time = time.time()
+                job.status.completion_time = clock.now()
             metrics.jobs_failed.labels().inc()
             result.terminal = True
             result.failed_reason = failure_reason
@@ -669,9 +669,9 @@ class JobReconciler:
         ttl = job.spec.run_policy.ttl_seconds_after_finished
         if ttl is None:
             return None
-        finish_time = job.status.completion_time or time.time()
+        finish_time = job.status.completion_time or clock.now()
         expires_at = finish_time + ttl
-        remaining = expires_at - time.time()
+        remaining = expires_at - clock.now()
         if remaining <= 0:
             try:
                 self.cluster.delete_job(job.metadata.namespace, job.metadata.name)
@@ -782,7 +782,7 @@ class JobReconciler:
         deadline = job.spec.run_policy.active_deadline_seconds
         if deadline is None or job.status.start_time is None:
             return False
-        return time.time() - job.status.start_time >= deadline
+        return clock.now() - job.status.start_time >= deadline
 
     def past_backoff_limit(self, job: TPUJob, pods: List[Pod]) -> bool:
         """Sum container restart counts of Running pods over restartable

@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ..api import constants
 from ..api.core import Event, Pod
+from ..utils import locks
 from ..utils import logging as tpulog
 from ..utils import metrics
 from .cluster import ClusterInterface, EventType, NotFound
@@ -75,8 +76,8 @@ class SlicePool:
 
     def __init__(self, total_chips: Optional[float] = None) -> None:
         self.total = total_chips
-        self.used = 0.0
-        self._lock = threading.Lock()
+        self.used = 0.0  # guarded-by: _lock
+        self._lock = locks.new_lock("slice-pool")
 
     def try_reserve(self, chips: float) -> bool:
         with self._lock:
@@ -112,19 +113,19 @@ class GangScheduler:
         # periodic retry sweep).  Binds run outside self._lock by design,
         # but two concurrent bind_pods calls would each snapshot node usage
         # before either posts, overcommitting a node's chips.
-        self._bind_lock = threading.Lock()
-        self._lock = threading.Lock()
+        self._bind_lock = locks.new_lock("gang-bind")
+        self._lock = locks.new_lock("gang-state")
         # group key -> reserved chips (admitted gangs)
-        self._admitted: Dict[str, float] = {}
+        self._admitted: Dict[str, float] = {}  # guarded-by: _lock
         # group key -> member pod names currently existing
-        self._members: Dict[str, Set[str]] = {}
+        self._members: Dict[str, Set[str]] = {}  # guarded-by: _lock
         # group key -> slice slot per pod NAME — name-keyed so a restarted
         # pod (deterministic name) reclaims its slice host.  Recorded under
         # the lock at allocation time so preemption handling never depends
         # on annotation writes that happen after the lock is dropped.
-        self._slots: Dict[str, SlotMap] = {}
+        self._slots: Dict[str, SlotMap] = {}  # guarded-by: _lock
         # (group key, shape) already warned unsatisfiable
-        self._warned: Set[tuple] = set()
+        self._warned: Set[tuple] = set()  # guarded-by: _lock
         register = getattr(cluster, "register_gang_scheduler", None)
         if register is not None:
             register(scheduler_name)
@@ -139,7 +140,7 @@ class GangScheduler:
         if retry_interval:
             threading.Thread(
                 target=self._retry_loop, args=(retry_interval,),
-                daemon=True, name="gang-retry",
+                daemon=True, name="tpujob-gang-retry",
             ).start()
 
     def _retry_loop(self, interval: float) -> None:
@@ -287,7 +288,7 @@ class GangScheduler:
                 plain.append(p)
         return sliced, plain
 
-    def _allocate_slices(self, key: str, sliced: List[Pod]):
+    def _allocate_slices(self, key: str, sliced: List[Pod]):  # requires-lock: _lock
         """All-or-nothing slice allocation for the gang's sliced members.
 
         Returns the pod->slice assignment [(pod, slice_id, host_rank)] or
@@ -414,6 +415,7 @@ class GangScheduler:
         self._apply_slice_assignment(assignment)
         self._bind_all(bind_plain + [pod for pod, _sid, _rank in assignment])
 
+    # requires-lock: _lock
     def _warn_unsatisfiable(self, key: str, namespace: str, group_name: str,
                             sliced: List[Pod]) -> None:
         """Surface 'this shape can NEVER be satisfied' (vs transient

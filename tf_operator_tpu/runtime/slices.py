@@ -19,7 +19,6 @@ lowercases too) so a spec written "4X8" finds a "4x8" inventory entry.
 """
 from __future__ import annotations
 
-import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 # Topology math lives at the api layer (the "4x8" strings are schema);
@@ -30,6 +29,7 @@ from ..api.types import (  # noqa: F401  (re-exports)
     topology_chips,
     topology_hosts,
 )
+from ..utils import locks
 from ..utils import logging as tpulog
 
 log = tpulog.logger_for_key("slice-provider")
@@ -109,7 +109,7 @@ class FakeSliceProvider(SliceProvider):
                     Slice(f"{accelerator}-{normalize_topology(topology)}-{i}",
                           accelerator, topology)
                 )
-        self._lock = threading.Lock()
+        self._lock = locks.new_lock("slice-provider")
         self._watchers: List[SliceWatchHandler] = []
 
     # -- SliceProvider --

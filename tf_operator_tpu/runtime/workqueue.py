@@ -20,6 +20,8 @@ import time
 from collections import deque
 from typing import Dict, Optional, Set
 
+from ..utils import locks
+
 
 class ShutDown(Exception):
     pass
@@ -29,15 +31,15 @@ class RateLimitingQueue:
     def __init__(
         self, base_delay: float = 0.005, max_delay: float = 1000.0
     ) -> None:
-        self._cond = threading.Condition()
-        self._queue: deque[str] = deque()
-        self._dirty: Set[str] = set()
-        self._processing: Set[str] = set()
-        self._failures: Dict[str, int] = {}
+        self._cond = locks.new_condition("workqueue")
+        self._queue: deque[str] = deque()  # guarded-by: _cond
+        self._dirty: Set[str] = set()  # guarded-by: _cond
+        self._processing: Set[str] = set()  # guarded-by: _cond
+        self._failures: Dict[str, int] = {}  # guarded-by: _cond
         self._base_delay = base_delay
         self._max_delay = max_delay
-        self._shutting_down = False
-        self._timers: Set[threading.Timer] = set()
+        self._shutting_down = False  # guarded-by: _cond
+        self._timers: Set[threading.Timer] = set()  # guarded-by: _cond
 
     # --- core queue semantics ---
 
@@ -53,11 +55,11 @@ class RateLimitingQueue:
     def get(self, timeout: Optional[float] = None) -> str:
         """Block until a key is available; raises ShutDown when drained."""
         with self._cond:
-            deadline = None if timeout is None else time.time() + timeout
+            deadline = None if timeout is None else time.monotonic() + timeout
             while not self._queue:
                 if self._shutting_down:
                     raise ShutDown()
-                remaining = None if deadline is None else deadline - time.time()
+                remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     raise TimeoutError()
                 self._cond.wait(timeout=remaining)
@@ -95,6 +97,7 @@ class RateLimitingQueue:
             self.add(key)
             return
         timer: threading.Timer = threading.Timer(delay, lambda: self._timer_fire(key, timer))
+        timer.name = f"tpujob-requeue-{key}"
         timer.daemon = True
         with self._cond:
             if self._shutting_down:

@@ -192,6 +192,6 @@ def start_api_server(cluster: ClusterInterface, port: int,
                      host: str = "127.0.0.1") -> ThreadingHTTPServer:
     server = ThreadingHTTPServer((host, port), make_handler(cluster))
     thread = threading.Thread(target=server.serve_forever, daemon=True,
-                              name="api-server")
+                              name="tpujob-api-server")
     thread.start()
     return server

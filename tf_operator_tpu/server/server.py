@@ -14,7 +14,6 @@ import argparse
 import json
 import logging
 import threading
-import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -137,7 +136,7 @@ def start_monitoring(port: int) -> ThreadingHTTPServer:
     """(ref: startMonitoring, main.go:39-50)"""
     server = ThreadingHTTPServer(("127.0.0.1", port), MonitoringHandler)
     thread = threading.Thread(target=server.serve_forever, daemon=True,
-                              name="monitoring")
+                              name="tpujob-monitoring")
     thread.start()
     return server
 

@@ -10,11 +10,11 @@ from __future__ import annotations
 
 import ctypes
 import os
-import threading
 from typing import Dict, Iterator, Optional
 
 import numpy as np
 
+from ..utils import locks
 from ..utils.native_build import load_native_lib
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
@@ -25,9 +25,9 @@ _KIND_IMAGES = 0
 _KIND_MNIST = 1
 _KIND_TOKENS = 2
 
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_build_failed = False
+_lock = locks.new_lock("native-data-build")
+_lib: Optional[ctypes.CDLL] = None  # guarded-by: _lock
+_build_failed = False  # guarded-by: _lock
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -106,7 +106,7 @@ class _NativeIterator:
     def __del__(self):  # pragma: no cover
         try:
             self.close()
-        except Exception:
+        except Exception:  # lint: allow(swallow) — interpreter-shutdown teardown; logging machinery may already be torn down
             pass
 
 

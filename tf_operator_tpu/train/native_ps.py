@@ -18,11 +18,11 @@ import ctypes
 import os
 import socket
 import struct
-import threading
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..utils import locks
 from ..utils.native_build import load_native_lib
 from .ps import BasePSClient
 
@@ -39,9 +39,9 @@ _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_build_failed = False
+_lock = locks.new_lock("native-ps-build")
+_lib: Optional[ctypes.CDLL] = None  # guarded-by: _lock
+_build_failed = False  # guarded-by: _lock
 
 
 def _load() -> Optional[ctypes.CDLL]:

@@ -30,6 +30,8 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils import locks
+
 _LEN = struct.Struct("!Q")
 
 
@@ -70,12 +72,13 @@ class ParameterServer(socketserver.ThreadingTCPServer):
         self.params = {k: np.asarray(v, np.float32).copy() for k, v in params.items()}
         self.lr = lr
         self.version = 0
-        self.lock = threading.Lock()
+        self.lock = locks.new_lock("ps-shard")
         self._shutdown_requested = threading.Event()
         super().__init__(address, _PSHandler)
 
     def serve_until_shutdown(self) -> None:
-        thread = threading.Thread(target=self.serve_forever, daemon=True)
+        thread = threading.Thread(target=self.serve_forever,
+                                  name="tpujob-ps-serve", daemon=True)
         thread.start()
         self._shutdown_requested.wait()
         self.shutdown()

@@ -1,0 +1,94 @@
+"""Injectable wall-clock seam for the control plane.
+
+`runtime/`, `controller/` and `server/` code is forbidden (by
+`tf_operator_tpu.analysis`, rule `wall-clock`) from calling `time.time()`
+directly: API-surface timestamps (job conditions, start/completion times,
+lease expiries, event timestamps) go through `clock.now()` so tests can pin
+them with a `FakeClock`, and *durations* use `time.monotonic()`, which is
+immune to wall-clock steps.
+
+This module lives in `utils/` — outside the lint scope — and is the one
+sanctioned `time.time()` call site.  The process-global default is swapped
+for tests with `use()`:
+
+    with clock.use(FakeClock(1000.0)) as fake:
+        ...           # clock.now() == 1000.0 everywhere
+        fake.advance(600)
+
+The seam is deliberately read-only and global (not threaded through every
+constructor): timestamps cross module boundaries freely — a condition
+stamped by the reconciler is compared by the status engine — so a single
+shared epoch source is the correct model, mirroring how the reference
+relies on the one kernel clock.
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Iterator
+
+
+class Clock:
+    """Real wall clock (the production default)."""
+
+    def now(self) -> float:
+        """Seconds since the Unix epoch, as `time.time()` reports them."""
+        return time.time()
+
+
+class FakeClock(Clock):
+    """Settable clock for tests: starts at `start`, moves only on demand."""
+
+    def __init__(self, start: float = 1_600_000_000.0) -> None:
+        from . import locks  # deferred: clock must stay import-light
+
+        self._lock = locks.new_lock("fake-clock")
+        self._now = float(start)  # guarded-by: _lock
+
+    def now(self) -> float:
+        with self._lock:
+            return self._now
+
+    def advance(self, seconds: float) -> float:
+        """Move time forward; returns the new now()."""
+        if seconds < 0:
+            raise ValueError("FakeClock only moves forward; use set_time()")
+        with self._lock:
+            self._now += seconds
+            return self._now
+
+    def set_time(self, now: float) -> None:
+        with self._lock:
+            self._now = float(now)
+
+
+_clock: Clock = Clock()
+
+
+def now() -> float:
+    """The package-wide wall-clock read: `clock.now()` everywhere a
+    timestamp is minted or compared in the control plane."""
+    return _clock.now()
+
+
+def get() -> Clock:
+    return _clock
+
+
+def set_clock(clk: Clock) -> Clock:
+    """Swap the process-global clock; returns the previous one.  Prefer the
+    `use()` context manager in tests — it restores on exit."""
+    global _clock
+    previous = _clock
+    _clock = clk
+    return previous
+
+
+@contextmanager
+def use(clk: Clock) -> Iterator[Clock]:
+    """Install `clk` for the duration of the block (test seam)."""
+    previous = set_clock(clk)
+    try:
+        yield clk
+    finally:
+        set_clock(previous)

@@ -1,0 +1,206 @@
+"""Named lock factories — the one sanctioned construction site for locks.
+
+Control-plane code is forbidden (by `tf_operator_tpu.analysis`, rule
+`bare-lock`) from calling `threading.Lock()` / `RLock()` / `Condition()`
+directly: every lock gets a name through `new_lock(name)` /
+`new_rlock(name)` / `new_condition(name)`, so deadlock reports and the
+opt-in instrumentation below can talk about "cluster" vs "gang-state"
+instead of anonymous `<locked _thread.lock object>`s.
+
+In production the factories return the raw primitives — zero overhead, full
+C-lock semantics.  Inside a `with locks.instrumented() as registry:` block
+they return `InstrumentedLock` wrappers that record, into the registry:
+
+  - the global acquisition sequence (who took what, in what order),
+  - per-lock hold times,
+  - the nested-acquisition pairs each thread exhibited (lock A held while
+    taking lock B), from which `registry.inversions()` derives A→B vs B→A
+    ordering conflicts — the classic deadlock precondition.
+
+The seam is opt-in and per-construction: objects built inside the block get
+instrumented locks; everything built outside keeps raw ones.  Tests wrap
+the *construction* of the system under test, not each use.  Conditions are
+named but never instrumented — wait/notify semantics require the raw
+primitive's owner bookkeeping.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+_registry: Optional["LockRegistry"] = None
+
+
+def new_lock(name: str) -> "threading.Lock | InstrumentedLock":
+    """A named mutex; instrumented when built inside `instrumented()`."""
+    if _registry is not None:
+        return InstrumentedLock(name, threading.Lock(), _registry)  # lint: allow(bare-lock)
+    return threading.Lock()  # lint: allow(bare-lock) — the factory is the seam
+
+
+def new_rlock(name: str) -> "threading.RLock | InstrumentedLock":
+    """A named re-entrant mutex; instrumented when built inside
+    `instrumented()`."""
+    if _registry is not None:
+        return InstrumentedLock(name, threading.RLock(), _registry, reentrant=True)  # lint: allow(bare-lock)
+    return threading.RLock()  # lint: allow(bare-lock) — the factory is the seam
+
+
+def new_condition(name: str) -> threading.Condition:
+    """A named condition variable.  Never instrumented (see module doc);
+    the name parameter keeps call sites self-describing and greppable."""
+    del name  # recorded nowhere yet; the signature is the convention
+    return threading.Condition()  # lint: allow(bare-lock) — the factory is the seam
+
+
+class InstrumentedLock:
+    """Context-manager lock wrapper that reports to a `LockRegistry`.
+
+    Supports the subset of the lock protocol the package uses: `with`,
+    `acquire(blocking=, timeout=)`, `release()`, `locked()`.  Re-entrant
+    acquisitions of an RLock-backed instance are recorded once per level
+    but never produce a self-ordering pair.
+    """
+
+    def __init__(self, name: str, inner, registry: "LockRegistry",
+                 reentrant: bool = False) -> None:
+        self.name = name
+        self.reentrant = reentrant
+        self._inner = inner
+        self._registry = registry
+        self._hold_depth = 0  # int writes are atomic under the GIL
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._hold_depth += 1
+            self._registry._on_acquire(self.name)
+        return got
+
+    def release(self) -> None:
+        self._registry._on_release(self.name)
+        self._hold_depth -= 1
+        self._inner.release()
+
+    def locked(self) -> bool:
+        # _thread.RLock grows .locked() only in Python 3.14; fall back to
+        # the wrapper's own hold count so the advertised protocol holds on
+        # every supported interpreter.
+        inner_locked = getattr(self._inner, "locked", None)
+        if inner_locked is not None:
+            return inner_locked()
+        return self._hold_depth > 0
+
+    def __enter__(self) -> "InstrumentedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc_info) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<InstrumentedLock {self.name!r} wrapping {self._inner!r}>"
+
+
+class LockRegistry:
+    """Acquisition-order + hold-time recorder shared by the instrumented
+    locks a test created.  All read accessors return snapshots."""
+
+    def __init__(self) -> None:
+        self._meta = threading.Lock()  # lint: allow(bare-lock) — registry internals
+        self._seq = 0  # guarded-by: _meta
+        # (seq, thread name, lock name) in global acquire order
+        self._acquisitions: List[Tuple[int, str, str]] = []  # guarded-by: _meta
+        # lock name -> seconds held, one entry per release
+        self._holds: Dict[str, List[float]] = {}  # guarded-by: _meta
+        # (outer, inner): thread took `inner` while holding `outer`
+        self._pairs: Set[Tuple[str, str]] = set()  # guarded-by: _meta
+        # thread ident -> [(lock name, t0), ...] held stack.  Registry-level
+        # (not threading.local) so a cross-thread release can evict the
+        # acquirer's entry instead of leaving it to poison every nesting
+        # pair that thread records afterwards.
+        self._stacks: Dict[int, List[Tuple[str, float]]] = {}  # guarded-by: _meta
+
+    # -- wiring used by InstrumentedLock ------------------------------
+
+    def _on_acquire(self, name: str) -> None:
+        ident = threading.get_ident()
+        with self._meta:
+            stack = self._stacks.setdefault(ident, [])
+            self._seq += 1
+            self._acquisitions.append(
+                (self._seq, threading.current_thread().name, name)
+            )
+            for held, _t0 in stack:
+                if held != name:
+                    self._pairs.add((held, name))
+            stack.append((name, time.monotonic()))
+
+    def _on_release(self, name: str) -> None:
+        ident = threading.get_ident()
+        released = time.monotonic()
+        with self._meta:
+            stack = self._stacks.get(ident, [])
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] == name:
+                    _name, t0 = stack.pop(i)
+                    self._holds.setdefault(name, []).append(released - t0)
+                    return
+            # Cross-thread release (acquired in A, released here in B) —
+            # legal for raw locks, so tolerated: evict the most recent
+            # matching entry from whichever thread acquired it, so that
+            # thread's later nestings don't record phantom pairs.
+            newest: Optional[Tuple[int, int, float]] = None
+            for oident, ostack in self._stacks.items():
+                for i in range(len(ostack) - 1, -1, -1):
+                    if ostack[i][0] == name:
+                        if newest is None or ostack[i][1] > newest[2]:
+                            newest = (oident, i, ostack[i][1])
+                        break
+            if newest is not None:
+                oident, i, t0 = newest
+                self._stacks[oident].pop(i)
+                self._holds.setdefault(name, []).append(released - t0)
+
+    # -- test-facing accessors ----------------------------------------
+
+    @property
+    def acquisitions(self) -> List[Tuple[int, str, str]]:
+        with self._meta:
+            return list(self._acquisitions)
+
+    def hold_times(self, name: str) -> List[float]:
+        with self._meta:
+            return list(self._holds.get(name, ()))
+
+    def pair_orders(self) -> Set[Tuple[str, str]]:
+        """All (outer, inner) nestings any thread exhibited."""
+        with self._meta:
+            return set(self._pairs)
+
+    def inversions(self) -> Set[Tuple[str, str]]:
+        """Lock pairs acquired in both orders — each is a potential
+        deadlock.  Empty set == globally consistent acquisition order."""
+        with self._meta:
+            return {
+                (a, b) for (a, b) in self._pairs
+                if a < b and (b, a) in self._pairs
+            }
+
+
+@contextmanager
+def instrumented() -> Iterator[LockRegistry]:
+    """Make the factories hand out `InstrumentedLock`s for the duration of
+    the block.  Opt-in per test (never autouse — the wrappers add a Python
+    frame to every acquire, which tier-1's 870s budget does not want on
+    every test)."""
+    global _registry
+    previous = _registry
+    registry = LockRegistry()
+    _registry = registry
+    try:
+        yield registry
+    finally:
+        _registry = previous

@@ -9,8 +9,9 @@ rendered in the Prometheus text exposition format.
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, Iterable, Tuple
+
+from . import locks
 
 
 class _Metric:
@@ -19,8 +20,8 @@ class _Metric:
         self.help = help_text
         self.kind = kind
         self.label_names = tuple(label_names)
-        self._values: Dict[Tuple[str, ...], float] = {}
-        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], float] = {}  # guarded-by: _lock
+        self._lock = locks.new_lock(f"metric-{name}")
 
     def labels(self, *label_values: str) -> "_Child":
         if len(label_values) != len(self.label_names):
@@ -75,8 +76,8 @@ class _Child:
 
 class Registry:
     def __init__(self) -> None:
-        self._metrics: Dict[str, _Metric] = {}
-        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}  # guarded-by: _lock
+        self._lock = locks.new_lock("metrics-registry")
 
     def counter(self, name: str, help_text: str, label_names: Iterable[str] = ()) -> _Metric:
         return self._register(name, help_text, "counter", label_names)

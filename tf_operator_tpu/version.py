@@ -7,6 +7,7 @@ shape (version, git sha, runtime) matches PrintVersionAndExit's output.
 """
 from __future__ import annotations
 
+import logging
 import os
 import platform
 import subprocess
@@ -26,8 +27,8 @@ def git_sha() -> str:
         )
         if out.returncode == 0:
             return out.stdout.strip()
-    except Exception:
-        pass
+    except Exception as err:
+        logging.getLogger("tpu_operator").debug("git sha unavailable: %s", err)
     return "unknown"
 
 
