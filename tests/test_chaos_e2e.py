@@ -16,8 +16,10 @@ condition ladder is monotonic (Created -> Running -> Succeeded, one entry
 per type), no pod outside the expected deterministic name set was ever
 created, and no expectations are left stuck.
 """
+import json
 import threading
 import time
+import urllib.request
 
 import pytest
 
@@ -25,19 +27,28 @@ from fake_apiserver import FakeApiServer
 from testutil import new_tpujob
 
 from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.api.types import JobConditionType, ReplicaType
 from tf_operator_tpu.controller.controller import (
     CONTROLLER_NAME,
     DEGRADED_RESYNC_FACTOR,
     TPUJobController,
 )
+from tf_operator_tpu.controller.health import (
+    ACTION_QUARANTINED,
+    SelfHealingConfig,
+    SyncHealth,
+)
 from tf_operator_tpu.runtime import conditions
 from tf_operator_tpu.runtime.cluster import InMemoryCluster
 from tf_operator_tpu.runtime.faults import (
     FAULT_CONFLICT,
+    FAULT_LATENCY,
+    FAULT_SERVER_ERROR,
     FAULT_THROTTLE,
     Fault,
     FaultInjector,
     FaultPlan,
+    FaultRule,
     FaultyCluster,
 )
 from tf_operator_tpu.runtime.k8s import (
@@ -47,6 +58,7 @@ from tf_operator_tpu.runtime.k8s import (
     RetryPolicy,
 )
 from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+from tf_operator_tpu.server.server import start_monitoring
 from tf_operator_tpu.utils import metrics
 
 pytestmark = pytest.mark.chaos
@@ -335,6 +347,389 @@ class TestDeterminism:
         inj = FaultInjector(plan)
         fired = [inj.for_request("GET", "/x") for _ in range(10)]
         assert sum(f is not None for f in fired) == 3
+
+
+# ---------------------------------------------------------------------------
+# self-healing layer (ISSUE 5): quarantine, watchdog, staleness, deep health
+
+
+def start_memory_kubelet(inner, interval=0.02):
+    """Kubelet sim for InMemoryCluster: phase-less/Pending pods -> Running,
+    Running -> Succeeded(0) on the next sweep (condition-ladder parity with
+    start_chaos_kubelet)."""
+    stop_event = threading.Event()
+
+    def loop():
+        while not stop_event.is_set():
+            for pod in inner.list_pods():
+                try:
+                    if pod.status.phase == PodPhase.PENDING:
+                        inner.set_pod_phase("default", pod.metadata.name,
+                                            PodPhase.RUNNING)
+                    elif pod.status.phase == PodPhase.RUNNING:
+                        inner.set_pod_phase("default", pod.metadata.name,
+                                            PodPhase.SUCCEEDED, exit_code=0)
+                except Exception:  # deleted between snapshot and write
+                    continue
+            stop_event.wait(interval)
+
+    thread = threading.Thread(target=loop, daemon=True, name="memory-kubelet")
+    thread.start()
+
+    def stop():
+        stop_event.set()
+        thread.join(timeout=5)
+
+    return stop
+
+
+def stuck_condition(job):
+    return next((c for c in job.status.conditions
+                 if c.type == JobConditionType.STUCK), None)
+
+
+def test_poison_job_quarantined_while_healthy_jobs_drain():
+    """The acceptance scenario's first half: one job whose sync always fails
+    (its pod creates are scripted to 500) must be quarantined — Stuck
+    condition + JobStuck event, requeues bounded to resync probes — while
+    every healthy job keeps reconciling to Succeeded.  When the fault budget
+    runs out the poison job recovers: quarantine released, Stuck retracted,
+    job completes."""
+    rules = [FaultRule(fault=Fault(FAULT_SERVER_ERROR, status=500,
+                                   message="injected poison"),
+                       op="create_pod", path="poison", times=12)]
+    injector = FaultInjector(FaultPlan(rules=rules, rate=0.0))
+    inner = InMemoryCluster()
+    cluster = FaultyCluster(inner, injector)
+    healing = SelfHealingConfig(quarantine_threshold=3,
+                                quarantine_probation=30.0,
+                                watchdog_interval=0.05)
+    controller = TPUJobController(
+        cluster, config=ReconcilerConfig(reconciler_sync_loop_period=0.1),
+        threadiness=2, healing=healing)
+    controller.start()
+    stop_kubelet = start_memory_kubelet(inner)
+    try:
+        inner.create_job(new_tpujob(worker=1, name="poison"))
+        for i in range(3):
+            inner.create_job(new_tpujob(worker=1, name=f"healthy-{i}"))
+
+        # healthy jobs drain to Succeeded while the poison job is failing
+        assert wait_for(lambda: all(
+            conditions.is_succeeded(inner.get_job("default", f"healthy-{i}").status)
+            for i in range(3)), timeout=30), "healthy jobs starved"
+
+        # the poison job is quarantined, not succeeded, and marked Stuck
+        assert wait_for(lambda: controller.sync_health.quarantine_count() == 1,
+                        timeout=10)
+        assert controller.sync_health.is_quarantined("default/poison")
+        poison = inner.get_job("default", "poison")
+        assert not conditions.is_succeeded(poison.status)
+        def poison_marked_stuck():
+            cond = stuck_condition(inner.get_job("default", "poison"))
+            return cond is not None and cond.status
+
+        assert wait_for(poison_marked_stuck, timeout=10), \
+            "Stuck condition never written"
+        events = inner.list_events(object_name="poison")
+        assert any(e.reason == "JobStuck" and e.event_type == "Warning"
+                   for e in events)
+
+        # bounded requeues: while quarantined, sync attempts only come from
+        # resync probes (0.1s period), never the hot backoff path
+        def poison_attempts():
+            return sum(1 for rec in injector.trace if "poison" in rec.path)
+
+        before = poison_attempts()
+        time.sleep(0.35)
+        delta = poison_attempts() - before
+        assert delta <= 5, f"quarantined job still hot-looping ({delta} attempts in 0.35s)"
+
+        # the health report shows the quarantine
+        report = controller.health_report()
+        assert report["queue"]["quarantined"] == 1
+        assert "default/poison" in report["quarantine"]["keys"]
+        assert report["quarantine"]["keys"]["default/poison"]["failures"] >= 3
+
+        # fault budget exhausts -> the next probe succeeds: quarantine
+        # released, Stuck retracted, job completes
+        assert wait_for(lambda: conditions.is_succeeded(
+            inner.get_job("default", "poison").status), timeout=30), \
+            f"poison job never recovered\n{injector.describe()}"
+        assert wait_for(
+            lambda: controller.sync_health.quarantine_count() == 0, timeout=10)
+        cond = stuck_condition(inner.get_job("default", "poison"))
+        assert cond is not None and cond.status is False
+        assert cond.reason == "SyncRecovered"
+        # rate-limiter state was forgotten along the way
+        assert controller.work_queue.num_requeues("default/poison") == 0
+    finally:
+        stop_kubelet()
+        controller.stop()
+
+
+def test_hung_sync_flags_watchdog_and_flips_healthz():
+    """The acceptance scenario's second half: one cluster call hangs (a
+    scripted latency fault far past the stuck-sync deadline).  The watchdog
+    must flag the in-flight sync, /healthz must flip to not-ready naming the
+    stuck key, stuck-sync metrics must engage, the second worker must keep
+    reconciling healthy jobs — and once the hang clears, health returns to
+    ready."""
+    hang = 1.2
+    rules = [FaultRule(fault=Fault(FAULT_LATENCY, latency=hang),
+                       op="get_job", path="default/slow", times=1)]
+    injector = FaultInjector(FaultPlan(rules=rules, rate=0.0))
+    inner = InMemoryCluster()
+    cluster = FaultyCluster(inner, injector)
+    healing = SelfHealingConfig(stuck_sync_deadline=0.25,
+                                watchdog_interval=0.05)
+    controller = TPUJobController(
+        cluster, config=ReconcilerConfig(reconciler_sync_loop_period=0.1),
+        threadiness=2, healing=healing)
+    controller.start()
+    monitoring = start_monitoring(0, health_provider=controller.health_report)
+    port = monitoring.server_address[1]
+    stop_kubelet = start_memory_kubelet(inner)
+
+    def fetch_healthz():
+        """(code, report) — not-ready answers 503 with the same JSON body."""
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=2) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read())
+
+    try:
+        assert wait_for(lambda: fetch_healthz()[1]["ready"], timeout=10), \
+            "controller never became ready"
+        inner.create_job(new_tpujob(worker=1, name="slow"))
+        inner.create_job(new_tpujob(worker=1, name="fine"))
+
+        # poll /healthz through the hang window: we must observe the flip
+        not_ready_seen = None
+        max_stuck_gauge = 0.0
+        deadline = time.time() + hang + 3.0
+        while time.time() < deadline:
+            code, report = fetch_healthz()
+            max_stuck_gauge = max(max_stuck_gauge,
+                                  metrics.stuck_syncs.labels().get())
+            if not report["ready"]:
+                not_ready_seen = (code, report)
+                break
+            time.sleep(0.02)
+        assert not_ready_seen is not None, \
+            f"healthz never flipped not-ready\n{injector.describe()}"
+        code, report = not_ready_seen
+        assert code == 503
+        assert report["live"] is True
+        # the liveness alias must NOT fail for a live-but-not-ready
+        # controller — a probe pointed at /livez would not restart it
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/livez", timeout=2) as resp:
+            assert resp.status == 200
+        assert any("stuck-sync" in r and "default/slow" in r
+                   for r in report["reasons"]), report["reasons"]
+        assert report["syncs"]["in_flight_stuck"], report["syncs"]
+
+        # stuck-sync metrics engaged (watchdog gauges)
+        assert wait_for(
+            lambda: metrics.stuck_syncs.labels().get() > 0
+            or max_stuck_gauge > 0, timeout=5)
+        assert wait_for(
+            lambda: "tpujob_stuck_syncs" in metrics.REGISTRY.render(),
+            timeout=1)
+
+        # the healthy job reconciles on the other worker despite the hang
+        assert wait_for(lambda: conditions.is_succeeded(
+            inner.get_job("default", "fine").status), timeout=30)
+
+        # hang clears -> ready again (the SDK parses the same report)
+        assert wait_for(lambda: fetch_healthz()[1]["ready"],
+                        timeout=hang + 10), "healthz never recovered"
+        from tf_operator_tpu.sdk.remote import RemoteCluster
+
+        sdk_report = RemoteCluster(f"http://127.0.0.1:{port}").healthz()
+        assert sdk_report["ready"] is True and sdk_report["live"] is True
+        assert sdk_report["workers"]["alive"] == 2
+        # and the hung job itself completes once the latency passed
+        assert wait_for(lambda: conditions.is_succeeded(
+            inner.get_job("default", "slow").status), timeout=30)
+    finally:
+        stop_kubelet()
+        monitoring.shutdown()
+        controller.stop()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_watchdog_respawns_dead_worker():
+    """A sync raising past the broad handler (SystemExit here, standing in
+    for any BaseException escape) kills its worker thread; the watchdog must
+    respawn it, count the restart, and the controller must keep working.
+    The injected thread death is expected — hence the filterwarnings."""
+    inner = InMemoryCluster()
+    healing = SelfHealingConfig(watchdog_interval=0.05)
+    controller = TPUJobController(
+        inner, config=ReconcilerConfig(reconciler_sync_loop_period=0.1),
+        threadiness=2, healing=healing)
+    bomb = {"armed": True}
+    orig_sync = controller.sync_job
+
+    def lethal(key):
+        if bomb["armed"] and key == "default/bomb":
+            bomb["armed"] = False
+            raise SystemExit("injected worker death")
+        return orig_sync(key)
+
+    controller.sync_job = lethal
+    controller.start()
+    try:
+        inner.create_job(new_tpujob(worker=1, name="bomb"))
+        assert wait_for(
+            lambda: controller.health_report()["workers"]["restarts"] >= 1,
+            timeout=10), "watchdog never respawned the dead worker"
+        assert wait_for(
+            lambda: controller.health_report()["workers"]["alive"] == 2,
+            timeout=10)
+        # end to end after the respawn: the job still completes
+        assert wait_for(lambda: len(inner.list_pods()) == 1, timeout=10)
+        inner.set_pod_phase("default", "bomb-worker-0", PodPhase.RUNNING)
+        inner.set_pod_phase("default", "bomb-worker-0", PodPhase.SUCCEEDED,
+                            exit_code=0)
+        assert wait_for(lambda: conditions.is_succeeded(
+            inner.get_job("default", "bomb").status), timeout=10)
+        assert controller.health_report()["ready"] is True
+    finally:
+        controller.stop()
+
+
+def test_stale_watch_force_reconnect_and_redeliver(fake):
+    """Watch staleness: a quiet stream past the deadline is force-closed,
+    counted in tpujob_watch_stale_total, re-armed (no double kick), and the
+    reconnected stream still delivers events end to end."""
+    server, url = fake
+    cluster = KubernetesCluster(
+        KubeConfig(host=url, namespace="default"), namespace="default",
+        qps=0, retry=fast_retry_policy())
+    seen = []
+    cluster.watch_jobs(lambda et, job: seen.append((et, job.metadata.name)))
+    try:
+        assert wait_for(lambda: "jobs" in cluster.watch_ages(), timeout=10)
+        base = metrics.watch_stale_total.value("jobs")
+        time.sleep(0.2)  # quiet stream: the heartbeat age grows
+        assert cluster.watch_ages()["jobs"] >= 0.15
+        assert cluster.kick_stale_watches(0.05) == ["jobs"]
+        assert metrics.watch_stale_total.value("jobs") == base + 1
+        # the kick re-armed the heartbeat: no immediate double kick
+        assert cluster.kick_stale_watches(0.05) == []
+        # the reconnected stream still delivers
+        cluster.create_job(new_tpujob(worker=1, name="after-stale"))
+        assert wait_for(lambda: any(n == "after-stale" for _et, n in seen),
+                        timeout=15), "reconnected watch never delivered"
+        assert "tpujob_watch_stale_total" in metrics.REGISTRY.render()
+    finally:
+        cluster.close()
+
+
+def test_standby_replica_reports_ready():
+    """A leader-election standby (controller never started) must be ready —
+    not-started only unreadies a replica that is *supposed* to be running —
+    and a ready report keeps the legacy {"status": "ok"} key so pre-upgrade
+    SDK pollers still read an upgraded healthy operator as up."""
+    controller = TPUJobController(InMemoryCluster())
+    try:
+        plain = controller.health_report()
+        assert plain["ready"] is False and plain["status"] == "not-ready"
+        standby = controller.health_report(standby_ok=True)
+        assert standby["ready"] is True and standby["live"] is True
+        assert standby["standby"] is True and standby["status"] == "ok"
+        controller.start()
+        assert wait_for(
+            lambda: controller.health_report(standby_ok=True)["ready"],
+            timeout=10)
+        started = controller.health_report(standby_ok=True)
+        assert started["standby"] is False and started["status"] == "ok"
+    finally:
+        controller.stop()
+    stopped = controller.health_report(standby_ok=True)
+    assert stopped["ready"] is False and stopped["live"] is False
+
+
+class TestSyncFailureBookkeeping:
+    """Satellites: the _sync_errors leak fix and forget-on-deletion."""
+
+    def test_sync_errors_bounded_and_cleared_on_success(self):
+        health = SyncHealth(SelfHealingConfig(sync_errors_cap=4,
+                                              quarantine_threshold=100))
+        for i in range(10):
+            health.record_sync_failure(f"default/j{i}", f"boom {i}")
+        errors = health.sync_errors()
+        assert len(errors) == 4, "sync-error detail is unbounded"
+        assert "default/j9" in errors and "default/j0" not in errors
+        health.record_sync_success("default/j9")
+        assert "default/j9" not in health.sync_errors()
+        # and the detail is surfaced in the health report
+        assert "default/j8" in health.report()["sync_errors"]
+
+    def test_notfound_releases_rate_limiter_and_quarantine(self):
+        inner = InMemoryCluster()
+        controller = TPUJobController(
+            inner, healing=SelfHealingConfig(quarantine_threshold=1))
+        key = "default/ghost"
+        controller.work_queue.add_rate_limited(key)
+        assert controller.work_queue.num_requeues(key) == 1
+        action = controller.sync_health.record_sync_failure(key, "boom")
+        assert action == ACTION_QUARANTINED
+        assert controller.sync_health.is_quarantined(key)
+        controller._sync_job(key)  # job does not exist -> NotFound path
+        assert controller.work_queue.num_requeues(key) == 0, \
+            "rate-limiter state leaked past job deletion"
+        assert not controller.sync_health.is_quarantined(key)
+        assert key not in controller.sync_health.sync_errors()
+
+    def test_spec_change_releases_quarantine(self):
+        inner = InMemoryCluster()
+        controller = TPUJobController(
+            inner, healing=SelfHealingConfig(quarantine_threshold=1))
+        job = new_tpujob(worker=1, name="editme")
+        inner.create_job(job)
+        key = job.key()
+        controller.work_queue.add_rate_limited(key)  # pre-edit backoff state
+        controller.sync_health.record_sync_failure(key, "boom")
+        assert controller.sync_health.is_quarantined(key)
+        # a status-only write (the controller's own) must NOT release
+        inner.update_job_status("default", "editme", job.status)
+        assert controller.sync_health.is_quarantined(key)
+        # a spec edit releases immediately — and the fresh start includes
+        # the rate-limiter ladder and the stale error detail
+        edited = inner.get_job("default", "editme")
+        edited.spec.replica_specs[ReplicaType.WORKER].replicas = 2
+        inner.update_job(edited)
+        assert not controller.sync_health.is_quarantined(key)
+        assert controller.work_queue.num_requeues(key) == 0, \
+            "spec-change release kept the pre-edit backoff ladder"
+        assert key not in controller.sync_health.sync_errors()
+
+    def test_stuck_condition_written_on_failed_job(self):
+        """The sticky-Failed rule must not swallow the Stuck marker: a
+        job that failed and whose cleanup sync then quarantines still
+        carries Stuck=True (conditions.set_operational_condition)."""
+        from tf_operator_tpu.runtime.conditions import (
+            set_operational_condition, update_job_conditions,
+        )
+        job = new_tpujob(worker=1, name="failed-poison")
+        update_job_conditions(job.status, JobConditionType.FAILED,
+                              "JobFailed", "workers exited nonzero")
+        # the state-machine path is (correctly) sticky...
+        update_job_conditions(job.status, JobConditionType.STUCK,
+                              "JobStuck", "ignored")
+        assert stuck_condition(job) is None
+        # ...the operational path is not
+        set_operational_condition(job.status, JobConditionType.STUCK,
+                                  "JobStuck", "sync failed 5x; quarantined")
+        cond = stuck_condition(job)
+        assert cond is not None and cond.status is True
 
 
 def test_degraded_mode_backstop():

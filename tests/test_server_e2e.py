@@ -116,8 +116,52 @@ spec:
         metrics_text = resp.read().decode()
     assert "tpu_operator_jobs_successful_total 1" in metrics_text
 
+    # self-healing gauges are exported (docs/self-healing.md)
+    for name in ("tpujob_queue_depth", "tpujob_quarantined_jobs",
+                 "tpujob_worker_restarts_total", "tpujob_stuck_syncs",
+                 "tpujob_stuck_sync_age_seconds", "tpujob_watch_stale_total"):
+        assert name in metrics_text, f"{name} missing from /metrics"
+
+    # deep health: aggregated live/ready JSON on the monitoring port...
+    with urllib.request.urlopen(f"http://127.0.0.1:{mon_port}/healthz", timeout=5) as resp:
+        report = json.loads(resp.read())
+    assert report["live"] is True and report["ready"] is True
+    assert report["workers"]["alive"] == 2
+    assert report["queue"]["quarantined"] == 0
+
+    # ...the probe-contract aliases serve the same report (livez follows
+    # the live verdict, readyz the ready one — docs/self-healing.md)
+    for probe in ("livez", "readyz"):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{mon_port}/{probe}", timeout=5) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["live"] is True
+
+    # ...and the same report through the SDK against the API port
+    from tf_operator_tpu.sdk.remote import RemoteCluster
+
+    sdk_report = RemoteCluster(base).healthz()
+    assert sdk_report["ready"] is True
+    assert sdk_report["workers"]["expected"] == 2
+
     result = run_cli(base, "delete", "smoke-e2e")
     assert result.returncode == 0
+
+
+def test_self_healing_flags_have_defaults():
+    """The self-healing knobs ride the server flag surface
+    (docs/self-healing.md) with conservative production defaults."""
+    from tf_operator_tpu.server.server import build_arg_parser
+
+    args = build_arg_parser().parse_args([])
+    assert args.quarantine_threshold == 5
+    assert args.quarantine_probation == 60.0
+    assert args.stuck_sync_deadline == 60.0
+    assert args.watch_stale_deadline == 300.0
+    tuned = build_arg_parser().parse_args(
+        ["--quarantine-threshold", "2", "--stuck-sync-deadline", "5"])
+    assert tuned.quarantine_threshold == 2
+    assert tuned.stuck_sync_deadline == 5.0
 
 
 class TestGangFlagValidation:

@@ -209,11 +209,25 @@ def test_throttled_hundred_job_soak(strict):
     limiter ON: the controller must still converge 100 jobs, and the
     limiter must demonstrably have engaged (real back-pressure, not a
     no-op).  Polling happens fixture-side so the assertion loop doesn't
-    consume the controller's token budget."""
+    consume the controller's token budget.
+
+    The engage threshold is DERIVED from this machine's measured request
+    rate instead of hard-coded: an unthrottled probe measures how fast the
+    client can actually reach the fixture, and the soak runs at 1/8 of
+    that, so the 100-job submission burst alone must overrun the bucket on
+    any host.  (The old hard-coded qps=400 flaked 'limiter never engaged'
+    on machines that could not generate 400 req/s in the first place.)"""
     server, url = strict
+    probe = KubeClient(KubeConfig(host=url, namespace="default"), qps=0)
+    t0 = time.perf_counter()
+    probe_requests = 40
+    for _ in range(probe_requests):
+        probe.request("GET", "/api/v1/namespaces/default/pods")
+    measured_rate = probe_requests / max(time.perf_counter() - t0, 1e-6)
+    qps = max(10.0, measured_rate / 8.0)
     cluster = KubernetesCluster(
         KubeConfig(host=url, namespace="default"), namespace="default",
-        qps=400, burst=100)
+        qps=qps, burst=25)
     controller = TPUJobController(
         cluster, config=ReconcilerConfig(reconciler_sync_loop_period=0.25),
         threadiness=4)
@@ -237,12 +251,14 @@ def test_throttled_hundred_job_soak(strict):
                         running += 1
             return running == n
 
-        deadline = time.time() + 120
+        deadline = time.time() + 180
         while time.time() < deadline and not all_running():
             time.sleep(0.1)
         assert all_running(), "throttled soak did not converge"
         limiter = cluster.client.limiter
-        assert limiter.wait_count > 0, "limiter never engaged"
+        assert limiter.wait_count > 0, (
+            f"limiter never engaged (measured_rate={measured_rate:.0f}/s, "
+            f"qps={qps:.0f})")
         assert limiter.wait_seconds > 0
     finally:
         stop_kubelet()

@@ -117,6 +117,10 @@ class JobConditionType(str, Enum):
     RESTARTING = "Restarting"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+    # No reference analogue: set (status True) when the controller
+    # quarantines a job after repeated consecutive sync failures, flipped
+    # False on the first successful sync (docs/self-healing.md).
+    STUCK = "Stuck"
 
 
 @dataclass

@@ -7,16 +7,26 @@ run the generic reconcile engine with TPU-specific plugin hooks (topology
 injection, master-role labeling, success matrix).  Expectations gate syncs so
 a stale store view never causes duplicate pod creation
 (ref: controller.go:319,339-358).
+
+On top of the reference's loop sits a self-healing layer (controller/health.py,
+docs/self-healing.md): a `tpujob-watchdog` thread respawns dead workers,
+flags hung syncs, and force-reconnects stale watch streams; poison jobs —
+keys whose sync fails `quarantine_threshold` times in a row — are parked out
+of the hot queue with a Stuck condition and probed once per resync tick, so
+one bad job cannot starve the others.  `health_report()` aggregates all of it
+into the live/ready verdict `/healthz` serves.
 """
 from __future__ import annotations
 
+import json
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from ..api import constants
 from ..api.core import Event, Pod, Service
 from ..api.defaults import set_defaults
+from ..api.serialization import job_to_dict
 from ..api.types import (
     JobConditionType,
     ReplicaSpec,
@@ -35,15 +45,23 @@ from ..runtime.reconciler import (
     ReconcilerConfig,
 )
 from ..runtime.workqueue import RateLimitingQueue, ShutDown
-from ..utils import locks
+from ..utils import clock, locks
 from ..utils import logging as tpulog
 from ..utils import metrics
 from . import status as status_engine
 from . import topology
+from .health import (
+    ACTION_QUARANTINED,
+    ACTION_REQUEUE,
+    SelfHealingConfig,
+    SyncHealth,
+)
 
 CONTROLLER_NAME = "tpujob-controller"
 
 FAILED_VALIDATION_REASON = "FailedValidation"
+JOB_STUCK_REASON = "JobStuck"
+JOB_RECOVERED_REASON = "SyncRecovered"
 
 # Degraded-mode backstop: when the substrate's ClientHealth reports this many
 # consecutive request giveups (runtime/k8s.py DEGRADED_GIVEUP_THRESHOLD), the
@@ -54,6 +72,17 @@ FAILED_VALIDATION_REASON = "FailedValidation"
 DEGRADED_RESYNC_FACTOR = 4.0
 
 
+def _spec_fingerprint(job: TPUJob) -> str:
+    """Stable digest of the job's spec, for release-on-spec-change: a
+    MODIFIED event whose spec digest differs from the last observed one is
+    a user edit, not one of the controller's own status writes."""
+    try:
+        return json.dumps(job_to_dict(job).get("spec", {}), sort_keys=True,
+                          default=str)
+    except (TypeError, ValueError):
+        return repr(job.spec)
+
+
 class TPUJobController(JobPlugin):
     def __init__(
         self,
@@ -61,6 +90,7 @@ class TPUJobController(JobPlugin):
         config: Optional[ReconcilerConfig] = None,
         resolver: topology.AddressResolver = topology.dns_resolver,
         threadiness: int = 1,
+        healing: Optional[SelfHealingConfig] = None,
     ) -> None:
         self.controller_name = CONTROLLER_NAME
         self.cluster = cluster
@@ -77,9 +107,16 @@ class TPUJobController(JobPlugin):
             config=config,
         )
         self.expectations = self.reconciler.expectations
+        self.healing = healing or SelfHealingConfig()
+        self.sync_health = SyncHealth(self.healing)
         self._stop = threading.Event()
-        self._threads: list[threading.Thread] = []
-        self._sync_errors: Dict[str, str] = {}
+        self._resync_now = threading.Event()  # watchdog-triggered resync
+        self._started = False
+        self._workers_lock = locks.new_lock("controller-workers")
+        self._workers: Dict[int, threading.Thread] = {}  # guarded-by: _workers_lock
+        self._worker_restarts = 0  # guarded-by: _workers_lock
+        self._aux_threads: List[threading.Thread] = []
+        self._watchdog: Optional[threading.Thread] = None
         # job keys already warned about disabled multislice emission;
         # check-and-add under _warned_lock so threadiness>1 emits exactly
         # one MultisliceDisabled event per job
@@ -103,11 +140,27 @@ class TPUJobController(JobPlugin):
         if etype == EventType.ADDED:
             self.add_job(job)
         elif etype == EventType.MODIFIED:
+            # Fingerprints are only computed for quarantined keys: the
+            # baseline is captured at quarantine entry (_mark_job_stuck), so
+            # the healthy steady state pays nothing for release-on-spec-change
+            # despite every controller status write arriving here as MODIFIED.
+            if (self.sync_health.is_quarantined(job.key())
+                    and self.sync_health.observe_spec(
+                        job.key(), _spec_fingerprint(job))):
+                # A spec edit releases quarantine: the fixed manifest gets a
+                # fresh start immediately, not after probation — including
+                # the rate-limiter's backoff ladder, or the first post-edit
+                # failure would requeue at near-max delay.
+                self.work_queue.forget(job.key())
+                tpulog.logger_for_key(job.key()).info(
+                    "spec change released quarantine")
             self.work_queue.add(job.key())
         elif etype == EventType.DELETED:
             # Pods/services are garbage-collected by ownership in real k8s;
             # our substrates clean up on terminal state instead.
             self.expectations.delete_expectations(job.key())
+            self.work_queue.forget(job.key())
+            self.sync_health.forget(job.key())
             with self._warned_lock:
                 self._multislice_warned.discard(job.key())
 
@@ -194,13 +247,29 @@ class TPUJobController(JobPlugin):
 
     def start(self) -> None:
         """Non-blocking run()."""
+        self._started = True
         for i in range(self.threadiness):
-            t = threading.Thread(target=self._run_worker, name=f"tpujob-worker-{i}", daemon=True)
-            t.start()
-            self._threads.append(t)
+            self._spawn_worker(i)
         resync = threading.Thread(target=self._resync_loop, name="tpujob-resync", daemon=True)
         resync.start()
-        self._threads.append(resync)
+        self._aux_threads.append(resync)
+        watchdog = threading.Thread(target=self._watchdog_loop,
+                                    name="tpujob-watchdog", daemon=True)
+        watchdog.start()
+        self._watchdog = watchdog
+        self._aux_threads.append(watchdog)
+
+    def _spawn_worker(self, worker_id: int) -> None:
+        thread = threading.Thread(target=self._run_worker, args=(worker_id,),
+                                  name=f"tpujob-worker-{worker_id}", daemon=True)
+        # Register AND start under the lock: a watchdog sweep between the
+        # two would see a registered-but-unstarted thread as not alive and
+        # double-spawn the worker id (two threads sharing one in-flight
+        # slot).  _run_worker never takes _workers_lock, so starting while
+        # holding it cannot deadlock.
+        with self._workers_lock:
+            self._workers[worker_id] = thread
+            thread.start()
 
     def _resync_loop(self) -> None:
         """Periodic full resync (ref: ReconcilerSyncLoopPeriod 15s,
@@ -210,7 +279,14 @@ class TPUJobController(JobPlugin):
         _check_degraded) and list failures skip the tick instead of killing
         the thread — the resync loop must outlive any apiserver outage."""
         base = self.reconciler.config.reconciler_sync_loop_period
-        while not self._stop.wait(timeout=self.resync_period_current):
+        while not self._stop.is_set():
+            # Wake early when the watchdog requests a triggered resync
+            # (stale-watch kick): the relist must NOT run on the watchdog
+            # thread, where a hung apiserver would block hang detection.
+            self._resync_now.wait(timeout=self.resync_period_current)
+            self._resync_now.clear()
+            if self._stop.is_set():
+                break
             # Whole tick under one guard: the resync thread must never die —
             # a dead backstop silently disables TTL/deadline policies AND
             # the degraded-mode detection that matters most mid-outage.
@@ -218,6 +294,10 @@ class TPUJobController(JobPlugin):
                 factor = (DEGRADED_RESYNC_FACTOR if self._check_degraded()
                           else 1.0)
                 self.resync_period_current = base * factor
+                # Each resync tick grants every quarantined key one probe:
+                # the tick's enqueue below delivers it to a worker, which
+                # admits exactly one sync attempt (controller/health.py).
+                self.sync_health.grant_probes()
                 for job in self.cluster.list_jobs():
                     self.work_queue.add(job.key())
             except Exception as err:  # noqa: BLE001 — transient; next tick retries
@@ -267,11 +347,14 @@ class TPUJobController(JobPlugin):
 
     def stop(self) -> None:
         self._stop.set()
+        self._resync_now.set()  # wake the resync loop out of its period wait
         self.work_queue.shutdown()
-        for t in self._threads:
+        with self._workers_lock:
+            workers = list(self._workers.values())
+        for t in workers + self._aux_threads:
             t.join(timeout=5)
 
-    def _run_worker(self) -> None:
+    def _run_worker(self, worker_id: int) -> None:
         while not self._stop.is_set():
             try:
                 key = self.work_queue.get(timeout=0.5)
@@ -280,13 +363,38 @@ class TPUJobController(JobPlugin):
             except TimeoutError:
                 continue
             try:
-                self.sync_job(key)
+                if not self.sync_health.admit(key):
+                    # Quarantined with no probe due: absorb the enqueue.  The
+                    # key comes back via resync probes, probation expiry, or
+                    # a spec change — never through the hot backoff path.
+                    continue
+                self.sync_health.record_sync_start(worker_id, key)
+                synced = self.sync_job(key)
                 self.work_queue.forget(key)
+                # Only a sync that actually ran a reconcile (not one gated
+                # by unsatisfied expectations, which does zero work) counts
+                # as the success that resets failure streaks and releases
+                # quarantine/Stuck.
+                if synced and self.sync_health.record_sync_success(key):
+                    self._clear_stuck_condition(key)
             except Exception as err:  # noqa: BLE001 — sync errors requeue with backoff
-                self._sync_errors[key] = str(err)
+                action = self.sync_health.record_sync_failure(key, str(err))
                 tpulog.logger_for_key(key).warning("sync failed: %s", err)
-                self.work_queue.add_rate_limited(key)
+                if action == ACTION_REQUEUE:
+                    self.work_queue.add_rate_limited(key)
+                else:
+                    if action == ACTION_QUARANTINED:
+                        self._mark_job_stuck(key, str(err))
+                    # Parked either way: the only scheduled retry is the
+                    # probation-expiry probe (resync ticks may come sooner).
+                    self.work_queue.add_after(
+                        key, self.healing.quarantine_probation)
             finally:
+                # In-flight until ALL per-key work is done, including the
+                # Stuck marker/clear writes above: those hit the same
+                # apiserver the sync just failed against, and a hang there
+                # must be as visible to the watchdog as a hang in sync_job.
+                self.sync_health.record_sync_end(worker_id)
                 self.work_queue.done(key)
 
     def sync_job(self, key: str) -> bool:
@@ -306,7 +414,12 @@ class TPUJobController(JobPlugin):
         try:
             job = self.cluster.get_job(namespace, name)
         except NotFound:
+            # The job is gone: release every per-key residue — expectations,
+            # rate-limiter backoff state, and any quarantine — or the maps
+            # grow one dead entry per deleted job for the process lifetime.
             self.expectations.delete_expectations(key)
+            self.work_queue.forget(key)
+            self.sync_health.forget(key)
             return True
 
         job = job.deepcopy()
@@ -330,6 +443,232 @@ class TPUJobController(JobPlugin):
             for rtype in job.spec.replica_specs
             for kind in ("pods", "services")
         )
+
+    # ------------------------------------------------------------------
+    # self-healing: quarantine surfacing + the watchdog
+    # (controller/health.py holds the state; docs/self-healing.md the story)
+
+    def _mark_job_stuck(self, key: str, error: str) -> None:
+        """Surface a fresh quarantine on the TPUJob itself: a Warning event
+        plus a Stuck=True condition.  Both best-effort — the job's sync is
+        already failing, and the marker must not take the worker down."""
+        namespace, _, name = key.partition("/")
+        failures = self.sync_health.failures(key)
+        message = (
+            f"sync failed {failures} consecutive times; quarantined with "
+            f"{self.healing.quarantine_probation:.0f}s probation (released "
+            f"early on spec change or resync probe): {error}")
+        try:
+            self.cluster.record_event(Event(
+                object_kind="TPUJob",
+                object_name=name,
+                namespace=namespace,
+                event_type="Warning",
+                reason=JOB_STUCK_REASON,
+                message=message,
+            ))
+            # deepcopy before mutating, like _sync_job: InMemoryCluster
+            # returns the live stored object, and a torn in-place condition
+            # write would race concurrent workers (and leak state on a
+            # failed update_job_status).
+            job = self.cluster.get_job(namespace, name).deepcopy()
+            # Baseline for release-on-spec-change: MODIFIED events only
+            # compare fingerprints for quarantined keys, against this.
+            self.sync_health.set_spec_baseline(key, _spec_fingerprint(job))
+            # set_operational_condition, not update_job_conditions: the
+            # sticky-Failed rule would silently drop Stuck on a job that
+            # already failed, and a failed job's cleanup sync can be
+            # exactly what is quarantining.
+            conditions.set_operational_condition(
+                job.status, JobConditionType.STUCK, JOB_STUCK_REASON, message)
+            self.cluster.update_job_status(namespace, name, job.status)
+        except NotFound:
+            self.sync_health.forget(key)
+        except Exception as err:  # noqa: BLE001 — marker is best-effort
+            tpulog.logger_for_key(key).warning(
+                "could not write Stuck condition: %s", err)
+
+    def _clear_stuck_condition(self, key: str) -> None:
+        """Retract Stuck=True after the first successful sync of a
+        previously quarantined job (best-effort, like the marker)."""
+        namespace, _, name = key.partition("/")
+        try:
+            job = self.cluster.get_job(namespace, name).deepcopy()
+            if conditions.clear_condition(
+                    job.status, JobConditionType.STUCK, JOB_RECOVERED_REASON,
+                    "sync succeeded; quarantine released"):
+                self.cluster.update_job_status(namespace, name, job.status)
+        except NotFound:
+            pass
+        except Exception as err:  # noqa: BLE001 — marker is best-effort
+            tpulog.logger_for_key(key).warning(
+                "could not clear Stuck condition: %s", err)
+
+    def _watchdog_loop(self) -> None:
+        """The `tpujob-watchdog` monitor: respawns dead workers, flags hung
+        syncs, force-reconnects stale watches, and keeps the self-healing
+        gauges fresh.  Every tick is guarded — the watchdog outliving its
+        own sweep errors is the whole point of having one."""
+        logged_stuck: set = set()  # (worker, key) pairs already warned
+        while not self._stop.wait(timeout=self.healing.watchdog_interval):
+            try:
+                self._watchdog_tick(logged_stuck)
+            except Exception as err:  # noqa: BLE001 — monitor must outlive any tick
+                tpulog.logger_for_key("watchdog").warning(
+                    "watchdog tick failed: %s", err)
+
+    def _watchdog_tick(self, logged_stuck: set) -> None:
+        log = tpulog.logger_for_key("watchdog")
+        # 1. Respawn dead workers.  A sync that escapes the broad handler
+        # (SystemExit, MemoryError, a C-extension abort surfaced as a
+        # BaseException) kills its thread; without respawn the controller
+        # silently loses 1/N of its throughput per incident.
+        with self._workers_lock:
+            dead = [(i, t) for i, t in self._workers.items()
+                    if not t.is_alive()]
+        for worker_id, _thread in dead:
+            if self._stop.is_set():
+                break
+            log.warning("worker %d died; respawning", worker_id)
+            with self._workers_lock:
+                self._worker_restarts += 1
+            metrics.worker_restarts.labels().inc()
+            self._spawn_worker(worker_id)
+
+        # 2. Hung syncs: flag in-flight syncs past the deadline.  The sync
+        # itself cannot be aborted safely (it may hold the reconcile's
+        # half-applied writes) — the watchdog's job is to make the hang
+        # loudly observable (metrics + not-ready) rather than silent.
+        stuck = self.sync_health.stuck_syncs()
+        metrics.stuck_syncs.labels().set(float(len(stuck)))
+        metrics.stuck_sync_age.labels().set(
+            max((s["age_seconds"] for s in stuck), default=0.0))
+        current = {(s["worker"], s["key"]) for s in stuck}
+        for entry in stuck:
+            pair = (entry["worker"], entry["key"])
+            if pair not in logged_stuck:
+                log.warning(
+                    "sync of %s on worker %d stuck for %.1fs (deadline %.1fs)",
+                    entry["key"], entry["worker"], entry["age_seconds"],
+                    self.healing.stuck_sync_deadline)
+        logged_stuck.clear()
+        logged_stuck.update(current)
+
+        # 3. Watch staleness (duck-typed: only the k8s substrate has
+        # heartbeats).  A kicked watch reconnects and relists on its own;
+        # the triggered resync below re-enqueues every job so anything the
+        # dead stream swallowed is reconciled immediately, not at the next
+        # resync tick.
+        kick = getattr(self.cluster, "kick_stale_watches", None)
+        if kick is not None:
+            stale = kick(self.healing.watch_stale_deadline)
+            if stale:
+                log.warning(
+                    "stale watches %s force-reconnected; triggering resync",
+                    stale)
+                # Delegate the relist to the resync thread: a stale watch
+                # usually means the apiserver is misbehaving, and a blocking
+                # list_jobs() here would wedge the watchdog itself through
+                # the client's whole retry budget.
+                self._resync_now.set()
+
+        # 4. Gauges the report and /metrics share.
+        stats = self.work_queue.stats()
+        metrics.queue_depth.labels().set(float(stats["depth"]))
+        metrics.quarantined_jobs.labels().set(
+            float(self.sync_health.quarantine_count()))
+
+    # ------------------------------------------------------------------
+    # deep health (served by /healthz on both HTTP surfaces)
+
+    def health_report(self, standby_ok: bool = False) -> dict:
+        """Aggregated self-health: the JSON `/healthz` serves.  `live` means
+        the control loop can still make progress (or the watchdog can
+        restore it); `ready` means it is currently healthy on every axis —
+        workers, in-flight syncs, watch freshness, and substrate health.
+        `standby_ok=True` (set by the server when leader election is on)
+        makes a deliberately not-started replica report ready: a standby
+        waiting for the lease is healthy by design and must not break the
+        Deployment's readiness rollout."""
+        stopped = self._stop.is_set()
+        with self._workers_lock:
+            workers = dict(self._workers)
+            restarts = self._worker_restarts
+        alive = sum(1 for t in workers.values() if t.is_alive())
+        standby = standby_ok and not self._started and not stopped
+        reasons: List[str] = []
+        if not self._started and not standby:
+            reasons.append("not-started: controller workers not running yet")
+        if stopped:
+            reasons.append("stopped: controller is shutting down")
+        if self._started and alive < self.threadiness:
+            reasons.append(f"workers: {alive}/{self.threadiness} alive")
+
+        stuck = self.sync_health.stuck_syncs()
+        for entry in stuck:
+            reasons.append(
+                f"stuck-sync: {entry['key']} on worker {entry['worker']} "
+                f"for {entry['age_seconds']:.1f}s "
+                f"(deadline {self.healing.stuck_sync_deadline:.1f}s)")
+
+        watches: Dict[str, dict] = {}
+        ages = getattr(self.cluster, "watch_ages", None)
+        if ages is not None:
+            for watch_key, age in ages().items():
+                is_stale = age > self.healing.watch_stale_deadline
+                watches[watch_key] = {
+                    "age_seconds": round(age, 3), "stale": is_stale,
+                }
+                if is_stale:
+                    reasons.append(
+                        f"watch: {watch_key} stale for {age:.1f}s")
+
+        degraded_report = None
+        substrate_health = getattr(self.cluster, "health", None)
+        if substrate_health is not None:
+            is_degraded = substrate_health.degraded()
+            degraded_report = {
+                "degraded": is_degraded,
+                "consecutive_giveups": substrate_health.consecutive_giveups,
+                "episodes": getattr(substrate_health, "episodes", 0),
+            }
+            if is_degraded:
+                reasons.append(
+                    "degraded: apiserver client in giveup backoff "
+                    f"({substrate_health.consecutive_giveups} consecutive)")
+
+        quarantine = self.sync_health.report()
+        watchdog_alive = self._watchdog.is_alive() if self._watchdog else False
+        live = not stopped and (not self._started
+                                or alive > 0 or watchdog_alive)
+        ready = (self._started or standby) and not stopped and not reasons
+        return {
+            # Legacy key: pre-upgrade SDK clients check status == "ok", so a
+            # ready server must keep answering it or old pollers read an
+            # upgraded healthy operator as down forever.
+            "status": "ok" if ready else "not-ready",
+            "live": live,
+            "ready": ready,
+            "standby": standby,
+            "reasons": reasons,
+            "timestamp": clock.now(),
+            "workers": {
+                "expected": self.threadiness,
+                "alive": alive,
+                "restarts": restarts,
+                "watchdog_alive": watchdog_alive,
+            },
+            "queue": dict(self.work_queue.stats(),
+                          quarantined=quarantine["count"]),
+            "syncs": {
+                "in_flight_stuck": stuck,
+                "stuck_sync_deadline_seconds": self.healing.stuck_sync_deadline,
+            },
+            "watches": watches,
+            "degraded": degraded_report,
+            "quarantine": quarantine,
+            "resync_period_seconds": self.resync_period_current,
+        }
 
     # ------------------------------------------------------------------
     # JobPlugin hooks

@@ -1,0 +1,262 @@
+"""Self-healing bookkeeping for the controller (docs/self-healing.md).
+
+The reference operator trusts client-go and the informer machinery to keep
+its control loop alive; its own failure modes — a job whose sync always
+throws, a sync that hangs on a wedged RPC, a worker thread that dies — are
+invisible and unhandled.  On preemptible TPU slices a wedged reconcile loop
+idles an entire slice (PAPERS.md: "Exploring the limits of Concurrency in ML
+Training on Google TPUs"), so this module makes those modes first-class
+state the `tpujob-watchdog` thread and the deep `/healthz` report act on:
+
+  - **poison-job quarantine**: after `quarantine_threshold` consecutive sync
+    failures a key is parked out of the hot queue.  While parked, enqueues
+    are absorbed without a sync; one probe is granted per resync tick and on
+    probation expiry, and a spec change releases the key entirely — so a
+    poison job costs one sync attempt per resync period instead of an
+    endless rate-limited requeue stream, and one bad job can never starve
+    the queue.
+  - **in-flight sync tracking**: workers register (key, start) around every
+    sync so the watchdog can flag syncs past `stuck_sync_deadline` and the
+    health report can show exactly which key is wedged on which worker.
+  - **bounded sync-error detail**: the last error per failing key (capped at
+    `sync_errors_cap`, cleared on success/deletion) for the health report.
+
+All state lives behind one leaf lock: no method calls out to the cluster,
+queue, or metrics while holding it, so the self-healing layer cannot join a
+lock cycle with the substrate (docs/static-analysis.md lock discipline).
+"""
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..utils import locks
+
+# record_sync_failure outcomes: the controller's requeue decision.
+ACTION_REQUEUE = "requeue"          # below threshold: normal rate-limited requeue
+ACTION_QUARANTINED = "quarantined"  # just crossed the threshold: park + mark
+ACTION_PARKED = "parked"            # probe failed: stay parked until next probe
+
+
+@dataclass
+class SelfHealingConfig:
+    """Tuning knobs for the self-healing layer (docs/self-healing.md)."""
+
+    # consecutive sync failures before a key is quarantined
+    quarantine_threshold: int = 5
+    # seconds a quarantined key waits before an expiry-driven probe;
+    # resync ticks and spec changes release/probe earlier
+    quarantine_probation: float = 60.0
+    # an in-flight sync older than this is reported stuck (and not-ready)
+    stuck_sync_deadline: float = 60.0
+    # a watch stream with no event/heartbeat for this long is force-reconnected
+    watch_stale_deadline: float = 300.0
+    # watchdog sweep period
+    watchdog_interval: float = 1.0
+    # bound on the per-key last-sync-error detail map
+    sync_errors_cap: int = 64
+
+
+@dataclass
+class _Quarantine:
+    since: float          # monotonic entry time (this episode)
+    until: float          # monotonic probation expiry for the next probe
+    failures: int
+    probe_granted: bool = False
+
+
+class SyncHealth:
+    """Quarantine + in-flight-sync + sync-error state, behind one leaf lock."""
+
+    def __init__(self, config: Optional[SelfHealingConfig] = None) -> None:
+        self.config = config or SelfHealingConfig()
+        self._lock = locks.new_lock("sync-health")
+        self._failures: Dict[str, int] = {}  # guarded-by: _lock
+        self._quarantine: Dict[str, _Quarantine] = {}  # guarded-by: _lock
+        # keys whose TPUJob carries a Stuck=True condition we still owe a clear
+        self._stuck_marked: Set[str] = set()  # guarded-by: _lock
+        # spec fingerprint per quarantined job (release-on-spec-change);
+        # baseline set at quarantine entry, dropped on release
+        self._spec_fps: Dict[str, str] = {}  # guarded-by: _lock
+        # key -> last sync error, newest last, bounded at sync_errors_cap
+        self._sync_errors: "OrderedDict[str, str]" = OrderedDict()  # guarded-by: _lock
+        # worker id -> (key, monotonic start) for the sync it is running now
+        self._in_flight: Dict[int, Tuple[str, float]] = {}  # guarded-by: _lock
+
+    # ------------------------------------------------------------------
+    # quarantine state machine
+
+    def admit(self, key: str) -> bool:
+        """Should a worker that just popped `key` actually sync it?  True
+        for healthy keys; for quarantined keys True only when a probe is
+        due (granted by a resync tick, a spec change, or probation expiry)
+        — consuming the probe and re-arming the probation timer."""
+        with self._lock:
+            q = self._quarantine.get(key)
+            if q is None:
+                return True
+            now = time.monotonic()
+            if q.probe_granted or now >= q.until:
+                q.probe_granted = False
+                q.until = now + self.config.quarantine_probation
+                return True
+            return False
+
+    def record_sync_failure(self, key: str, error: str) -> str:
+        """Count a failed sync; returns the requeue action for the caller
+        (ACTION_REQUEUE / ACTION_QUARANTINED / ACTION_PARKED)."""
+        with self._lock:
+            n = self._failures.get(key, 0) + 1
+            self._failures[key] = n
+            self._sync_errors[key] = error
+            self._sync_errors.move_to_end(key)
+            while len(self._sync_errors) > self.config.sync_errors_cap:
+                self._sync_errors.popitem(last=False)
+            q = self._quarantine.get(key)
+            if q is not None:
+                q.failures = n
+                return ACTION_PARKED
+            if n >= self.config.quarantine_threshold:
+                now = time.monotonic()
+                self._quarantine[key] = _Quarantine(
+                    since=now, until=now + self.config.quarantine_probation,
+                    failures=n)
+                self._stuck_marked.add(key)
+                return ACTION_QUARANTINED
+            return ACTION_REQUEUE
+
+    def record_sync_success(self, key: str) -> bool:
+        """Clear all failure state for `key`; returns True when the job
+        carries a Stuck condition the controller should now retract."""
+        with self._lock:
+            self._failures.pop(key, None)
+            self._sync_errors.pop(key, None)
+            self._quarantine.pop(key, None)
+            self._spec_fps.pop(key, None)
+            was_marked = key in self._stuck_marked
+            self._stuck_marked.discard(key)
+            return was_marked
+
+    def grant_probes(self) -> List[str]:
+        """A resync tick grants every quarantined key one probe; returns the
+        granted keys so the caller can log/observe."""
+        with self._lock:
+            for q in self._quarantine.values():
+                q.probe_granted = True
+            return list(self._quarantine)
+
+    def set_spec_baseline(self, key: str, fingerprint: str) -> None:
+        """Record the quarantine-entry spec fingerprint later MODIFIED
+        events compare against (no probe, no release — this is the
+        reference point, not an observation)."""
+        with self._lock:
+            self._spec_fps[key] = fingerprint
+
+    def observe_spec(self, key: str, fingerprint: str) -> bool:
+        """Track the job's spec fingerprint.  Only called for quarantined
+        keys (the baseline is captured at quarantine entry, subsequent
+        MODIFIED events compare against it), so the map stays as small as
+        the quarantine itself.  A changed spec releases the quarantine (the
+        operator's contract: a fixed manifest gets a fresh start
+        immediately, not after probation) and returns True.
+
+        A quarantined key with NO baseline means the entry-time get_job
+        failed (best-effort) — this MODIFIED could itself be the user's
+        fixing edit, so grant a probe: one immediate sync attempt instead
+        of waiting out the resync tick, without the unbounded-release risk
+        of treating every baseline-less event as an edit."""
+        with self._lock:
+            previous = self._spec_fps.get(key)
+            self._spec_fps[key] = fingerprint
+            q = self._quarantine.get(key)
+            if q is None:
+                return False
+            if previous is None:
+                q.probe_granted = True
+                return False
+            if previous != fingerprint:
+                self._quarantine.pop(key)
+                self._failures.pop(key, None)
+                self._spec_fps.pop(key, None)
+                # The pre-edit error is no longer this spec's error; keep
+                # _stuck_marked so the first success still retracts the
+                # condition.
+                self._sync_errors.pop(key, None)
+                return True
+            return False
+
+    def forget(self, key: str) -> None:
+        """Drop every trace of `key` (job deleted / NotFound)."""
+        with self._lock:
+            self._failures.pop(key, None)
+            self._quarantine.pop(key, None)
+            self._stuck_marked.discard(key)
+            self._spec_fps.pop(key, None)
+            self._sync_errors.pop(key, None)
+
+    def is_quarantined(self, key: str) -> bool:
+        with self._lock:
+            return key in self._quarantine
+
+    def quarantine_count(self) -> int:
+        with self._lock:
+            return len(self._quarantine)
+
+    def failures(self, key: str) -> int:
+        with self._lock:
+            return self._failures.get(key, 0)
+
+    # ------------------------------------------------------------------
+    # in-flight sync tracking (the watchdog's raw material)
+
+    def record_sync_start(self, worker_id: int, key: str) -> None:
+        with self._lock:
+            self._in_flight[worker_id] = (key, time.monotonic())
+
+    def record_sync_end(self, worker_id: int) -> None:
+        with self._lock:
+            self._in_flight.pop(worker_id, None)
+
+    def stuck_syncs(self, deadline: Optional[float] = None) -> List[dict]:
+        """In-flight syncs older than `deadline` (default: the configured
+        stuck_sync_deadline), oldest first."""
+        if deadline is None:
+            deadline = self.config.stuck_sync_deadline
+        now = time.monotonic()
+        with self._lock:
+            snapshot = list(self._in_flight.items())
+        stuck = [
+            {"worker": worker_id, "key": key, "age_seconds": now - start}
+            for worker_id, (key, start) in snapshot
+            if now - start > deadline
+        ]
+        stuck.sort(key=lambda entry: -entry["age_seconds"])
+        return stuck
+
+    # ------------------------------------------------------------------
+    # health-report detail
+
+    def sync_errors(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._sync_errors)
+
+    def report(self) -> dict:
+        """Quarantine + error detail for the aggregated health report."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                "count": len(self._quarantine),
+                "keys": {
+                    key: {
+                        "failures": q.failures,
+                        "quarantined_for_seconds": round(now - q.since, 3),
+                        "next_probe_in_seconds": round(max(0.0, q.until - now), 3),
+                        "probe_granted": q.probe_granted,
+                        "last_error": self._sync_errors.get(key, ""),
+                    }
+                    for key, q in self._quarantine.items()
+                },
+                "sync_errors": dict(self._sync_errors),
+            }

@@ -91,6 +91,41 @@ def update_job_conditions(
     _set_condition(status.conditions, cond)
 
 
+def set_operational_condition(
+    status: JobStatus, ctype: JobConditionType, reason: str, message: str
+) -> None:
+    """Set `ctype` true, bypassing the sticky-Failed rule.  Operational
+    markers (Stuck) describe the controller's handling of the job, not the
+    job's own state machine, so they must stay writable on a Failed job —
+    a failed job whose cleanup sync keeps throwing still quarantines, and
+    the condition is the documented signal for it.  Same (status, reason)
+    still no-ops so repeated markers don't churn timestamps."""
+    current = get_condition(status, ctype)
+    if current is not None and current.status is True and current.reason == reason:
+        return
+    _set_condition(status.conditions, new_condition(ctype, reason, message))
+
+
+def clear_condition(
+    status: JobStatus, ctype: JobConditionType, reason: str, message: str
+) -> bool:
+    """Flip condition `ctype` to False in place (keeping it in the list as
+    history, the way terminal conditions flip Running to False).  Returns
+    True when a change was made — callers skip the status write otherwise.
+    Used by the self-healing layer to retract Stuck once a quarantined job
+    syncs again."""
+    current = get_condition(status, ctype)
+    if current is None or not current.status:
+        return False
+    now = clock.now()
+    current.status = False
+    current.reason = reason
+    current.message = message
+    current.last_update_time = now
+    current.last_transition_time = now
+    return True
+
+
 def _set_condition(conditions: List[JobCondition], cond: JobCondition) -> None:
     current = next((c for c in conditions if c.type == cond.type), None)
     if current is not None:

@@ -31,6 +31,7 @@ _apply_fault / stream_watch):
 from __future__ import annotations
 
 import random
+import re
 from dataclasses import dataclass, field
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -76,6 +77,30 @@ class FaultRecord:
     fault: Fault
 
 
+@dataclass
+class FaultRule:
+    """A targeted, deterministic fault: fire `fault` on every consult whose
+    op/path match the given regexes (empty = match anything), up to `times`
+    consults (None = forever).  Rules are what chaos tests use to pin a
+    failure to one object — "this job's pod creates always 500", "this
+    job's get hangs once" — which seeded randomness cannot express.  Rules
+    are consulted before the seeded/scripted schedule; a non-matching
+    consult falls through to it."""
+
+    fault: Fault
+    op: str = ""             # regex over the verb / ClusterInterface method
+    path: str = ""           # regex over the path / call detail
+    scope: str = "request"   # "request" (also cluster calls) | "watch"
+    times: Optional[int] = None
+    fired: int = 0           # mutated under the owning plan's lock
+
+    def matches(self, op: str, path: str) -> bool:
+        if self.times is not None and self.fired >= self.times:
+            return False
+        return (re.search(self.op, op) is not None
+                and re.search(self.path, path) is not None)
+
+
 class FaultPlan:
     """Seeded-or-scripted fault schedule.
 
@@ -98,8 +123,10 @@ class FaultPlan:
                  max_faults: Optional[int] = None,
                  retry_after_range: Tuple[float, float] = (0.01, 0.05),
                  latency_range: Tuple[float, float] = (0.005, 0.02),
-                 script: Optional[Sequence[Optional[Fault]]] = None) -> None:
+                 script: Optional[Sequence[Optional[Fault]]] = None,
+                 rules: Optional[Sequence[FaultRule]] = None) -> None:
         self.seed = seed
+        self.rules: List[FaultRule] = list(rules or ())  # guarded-by: _lock
         self.rate = float(rate)
         self.watch_rate = float(watch_rate)
         self.kinds = tuple(kinds)
@@ -156,20 +183,33 @@ class FaultPlan:
                          message="injected 410: watch history expired")
         raise ValueError(f"unknown fault kind {kind!r}")
 
+    def _rule_fault(self, scope: str, op: str, path: str) -> Optional[Fault]:  # requires-lock: _lock
+        for rule in self.rules:
+            if rule.scope == scope and rule.matches(op, path):
+                rule.fired += 1
+                return rule.fault
+        return None
+
     def next_request_fault(self, op: str, path: str) -> Optional[Fault]:
         with self._lock:
-            if self._script is not None:
-                fault = self._script.pop(0) if self._script else None
-            elif self._spent() or not self.kinds or self._rng.random() >= self.rate:
-                fault = None
-            else:
-                fault = self._make(self._rng.choice(self.kinds))
+            fault = self._rule_fault("request", op, path)
+            if fault is None:
+                if self._script is not None:
+                    fault = self._script.pop(0) if self._script else None
+                elif self._spent() or not self.kinds or self._rng.random() >= self.rate:
+                    fault = None
+                else:
+                    fault = self._make(self._rng.choice(self.kinds))
             if fault is not None:
                 self._injected += 1
             return fault
 
     def next_watch_fault(self, path: str) -> Optional[Fault]:
         with self._lock:
+            fault = self._rule_fault("watch", "WATCH", path)
+            if fault is not None:
+                self._injected += 1
+                return fault
             if self._watch_script is not None:
                 fault = (self._watch_script.pop(0)
                          if self._watch_script else None)
@@ -211,10 +251,15 @@ class FaultInjector:
         return self._record(
             "watch", "WATCH", path, self.plan.next_watch_fault(path))
 
-    def for_cluster_call(self, method_name: str) -> Optional[Fault]:
+    def for_cluster_call(self, method_name: str,
+                         detail: Optional[str] = None) -> Optional[Fault]:
+        """`detail` (when FaultyCluster can derive one) is the call's object
+        path — "default/jobname" or "default/jobname-worker-0" — so
+        FaultRules can target one object and the trace names what was hit."""
+        path = detail or method_name
         return self._record(
-            "cluster", method_name, method_name,
-            self.plan.next_request_fault(method_name, method_name))
+            "cluster", method_name, path,
+            self.plan.next_request_fault(method_name, path))
 
     def describe(self) -> str:
         """Human-readable trace for chaos failure reports — paste-able next
@@ -247,6 +292,22 @@ _FAULTED_PREFIXES = (
 _PASSTHROUGH = {"list_events"}
 
 
+def _call_detail(args: Tuple[Any, ...], kwargs: dict) -> Optional[str]:
+    """Best-effort object path for a ClusterInterface call: string args
+    joined ("default/name" for (namespace, name) signatures), or the
+    metadata of an object argument ("default/name-worker-0" for
+    create_pod(pod)).  None when nothing identifying is present."""
+    parts: List[str] = []
+    for arg in list(args) + list(kwargs.values()):
+        if isinstance(arg, str):
+            parts.append(arg)
+        else:
+            meta = getattr(arg, "metadata", None)
+            if meta is not None:
+                parts.append(f"{meta.namespace}/{meta.name}")
+    return "/".join(parts) or None
+
+
 class FaultyCluster:
     """ClusterInterface delegate that injects plan faults per method call.
 
@@ -272,7 +333,8 @@ class FaultyCluster:
             return attr
 
         def faulted(*args: Any, **kwargs: Any) -> Any:
-            fault = self._injector.for_cluster_call(name)
+            fault = self._injector.for_cluster_call(
+                name, _call_detail(args, kwargs))
             if fault is not None:
                 self._raise(fault, name)
             return attr(*args, **kwargs)

@@ -505,6 +505,7 @@ class ClientHealth:
         self._consecutive_giveups = 0  # guarded-by: _lock
         self._consecutive_successes = 0  # guarded-by: _lock
         self._degraded = False  # guarded-by: _lock
+        self._episodes = 0  # guarded-by: _lock
 
     def record_success(self) -> None:
         with self._lock:
@@ -520,12 +521,22 @@ class ClientHealth:
             self._consecutive_successes = 0
             self._consecutive_giveups += 1
             if self._consecutive_giveups >= self.threshold:
+                if not self._degraded:
+                    self._episodes += 1
                 self._degraded = True
 
     @property
     def consecutive_giveups(self) -> int:
         with self._lock:
             return self._consecutive_giveups
+
+    @property
+    def episodes(self) -> int:
+        """Total degraded episodes entered over this client's lifetime —
+        surfaced in the deep health report so a flapping control plane is
+        visible even when the current verdict is healthy."""
+        with self._lock:
+            return self._episodes
 
     def degraded(self) -> bool:
         with self._lock:
@@ -917,6 +928,24 @@ def _error_message(payload: bytes) -> str:
 # the ClusterInterface backend
 
 
+class _WatchState:
+    """Supervision record for one watch stream: the heartbeat timestamp the
+    staleness detector reads, the live connections a kick force-closes, and
+    everything needed to respawn the thread if it ever dies."""
+
+    def __init__(self, key: str, path: str, convert: Callable[[dict], Any],
+                 handlers: List["WatchHandler"]) -> None:
+        self.key = key
+        self.path = path
+        self.convert = convert
+        self.handlers = handlers
+        # monotonic time of the last sign of life: a relist completing, an
+        # event line, or a bookmark.  Float writes are atomic under the GIL;
+        # readers tolerate a torn-by-one-tick view.
+        self.last_event = time.monotonic()
+        self.conns: List[Any] = []
+
+
 class KubernetesCluster(ClusterInterface):
     """Drives a real apiserver; the controller above it is unchanged."""
 
@@ -940,7 +969,7 @@ class KubernetesCluster(ClusterInterface):
         self._pod_handlers: List[WatchHandler] = []
         self._service_handlers: List[WatchHandler] = []
         self._watch_threads: Dict[str, threading.Thread] = {}
-        self._watch_conns: List[Any] = []
+        self._watch_state: Dict[str, _WatchState] = {}
         self._event_seq = 0
         self._identity = f"tpu-operator-{os.getpid()}"
         # Which API group PodGroups live in: Volcano's (default, reference
@@ -1446,6 +1475,10 @@ class KubernetesCluster(ClusterInterface):
     def _ensure_watch(self, key: str, path: str,
                       convert: Callable[[dict], Any],
                       handlers: List[WatchHandler]) -> None:
+        state = self._watch_state.get(key)
+        if state is None:
+            state = _WatchState(key, path, convert, handlers)
+            self._watch_state[key] = state
         existing = self._watch_threads.get(key)
         if existing is not None and existing.is_alive():
             return
@@ -1455,14 +1488,14 @@ class KubernetesCluster(ClusterInterface):
             # so supervise anyway; client-go informers always reconnect).
             log.warning("watch thread %s found dead; restarting", key)
         thread = threading.Thread(
-            target=self._watch_loop, args=(path, convert, handlers),
+            target=self._watch_loop, args=(state,),
             daemon=True, name=f"k8s-watch-{key}",
         )
         self._watch_threads[key] = thread
         thread.start()
 
-    def _watch_loop(self, path: str, convert: Callable[[dict], Any],
-                    handlers: List[WatchHandler]) -> None:
+    def _watch_loop(self, state: _WatchState) -> None:
+        path, convert, handlers = state.path, state.convert, state.handlers
         resource_version = ""
         # ns/name -> last converted object: lets a relist after a stream gap
         # emit synthetic DELETEDs for objects that vanished during the gap
@@ -1489,11 +1522,15 @@ class KubernetesCluster(ClusterInterface):
                     for gone_key in set(known) - set(seen):
                         self._dispatch(handlers, EventType.DELETED, known[gone_key])
                     known = seen
+                    state.last_event = time.monotonic()
                 params = {"resourceVersion": resource_version,
                           "allowWatchBookmarks": "true"}
                 for evt in self.client.stream_watch(
-                    path, params, self._stop, conn_registry=self._watch_conns
+                    path, params, self._stop, conn_registry=state.conns
                 ):
+                    # Any frame — data, bookmark, even an ERROR — is a
+                    # heartbeat: the stream demonstrably still delivers.
+                    state.last_event = time.monotonic()
                     etype = evt.get("type", "")
                     obj_raw = evt.get("object") or {}
                     if etype == "BOOKMARK":
@@ -1548,6 +1585,70 @@ class KubernetesCluster(ClusterInterface):
                 handler(etype, obj)
             except Exception:  # noqa: BLE001 — one handler must not kill the watch
                 log.exception("watch handler failed")
+
+    # -- watch staleness (the self-healing heartbeat; docs/self-healing.md) --
+
+    def watch_ages(self) -> Dict[str, float]:
+        """Seconds since each watch stream last showed a sign of life (a
+        relist completing, an event, or a bookmark).  Feeds the deep health
+        report's per-watch freshness detail.  Called from HTTP handler
+        threads while _ensure_watch may be registering a new stream, so
+        iterate a snapshot — a plain dict comprehension would raise
+        'dictionary changed size during iteration'."""
+        now = time.monotonic()
+        return {key: now - state.last_event
+                for key, state in list(self._watch_state.items())}
+
+    def kick_stale_watches(self, max_age: float) -> List[str]:
+        """Force-reconnect every watch stream older than `max_age`.
+
+        A watch can be 'alive' (thread running) yet blind: the connection's
+        peer is gone but TCP never noticed, so the reader is parked in recv
+        forever and the controller silently stops seeing events.  Closing
+        the socket from here makes the read fail, which sends the loop
+        through its normal error path: reconnect + relist (replaying missed
+        state as ADDED/MODIFIED/synthetic DELETED).  The heartbeat is reset
+        on kick so a reconnecting watch isn't re-kicked every sweep.
+        Returns the kicked watch keys; increments tpujob_watch_stale_total
+        per kick."""
+        now = time.monotonic()
+        stale: List[str] = []
+        for key, state in list(self._watch_state.items()):
+            age = now - state.last_event
+            if age <= max_age:
+                continue
+            stale.append(key)
+            state.last_event = now  # re-arm: give the reconnect a full window
+            metrics.watch_stale_total.labels(key).inc()
+            log.warning("watch %s stale for %.1fs (deadline %.1fs); "
+                        "forcing reconnect", key, age, max_age)
+            self._close_conns(state.conns)
+            # Belt and braces: if the thread itself died, the supervisor
+            # respawns it from the recorded state.
+            self._ensure_watch(key, state.path, state.convert, state.handlers)
+        return stale
+
+    @staticmethod
+    def _close_conns(conns: List[Any]) -> None:
+        """Break live watch connections so parked readers wake with EOF.
+        shutdown() first: it unblocks a recv from another thread, whereas
+        conn.close() alone can DEADLOCK — the watch thread holds the
+        response buffer lock inside read1() (chunked decoding), and
+        HTTPConnection.close() -> response.close() -> fp.close() blocks
+        acquiring that same lock."""
+        import socket as _socket
+
+        for conn in list(conns):
+            sock = getattr(conn, "sock", None)
+            if sock is not None:
+                try:
+                    sock.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # -- leases (leader election) --
 
@@ -1624,25 +1725,10 @@ class KubernetesCluster(ClusterInterface):
 
     def close(self) -> None:
         self._stop.set()
-        # Unblock watch threads parked in recv on timeout-less connections.
-        # shutdown() first: it wakes a blocked recv with EOF from another
-        # thread, whereas conn.close() alone can DEADLOCK — the watch thread
-        # holds the response buffer lock inside read1() (chunked decoding),
-        # and HTTPConnection.close() -> response.close() -> fp.close() blocks
-        # acquiring that same lock.
-        import socket as _socket
-
-        for conn in list(self._watch_conns):
-            sock = getattr(conn, "sock", None)
-            if sock is not None:
-                try:
-                    sock.shutdown(_socket.SHUT_RDWR)
-                except OSError:
-                    pass
-            try:
-                conn.close()
-            except OSError:
-                pass
+        # Unblock watch threads parked in recv on timeout-less connections
+        # (see _close_conns for why shutdown-then-close, in that order).
+        for state in list(self._watch_state.values()):
+            self._close_conns(state.conns)
 
 
 def default_config() -> KubeConfig:

@@ -110,6 +110,21 @@ class RateLimitingQueue:
             self._timers.discard(timer)
         self.add(key)
 
+    # --- observability ---
+
+    def stats(self) -> Dict[str, int]:
+        """One consistent snapshot for the health report / watchdog gauges:
+        depth (keys deliverable now), dirty (pending incl. redeliveries),
+        processing (keys a worker holds), and backoff_tracked (keys with
+        rate-limiter state — the set forget() clears)."""
+        with self._cond:
+            return {
+                "depth": len(self._queue),
+                "dirty": len(self._dirty),
+                "processing": len(self._processing),
+                "backoff_tracked": len(self._failures),
+            }
+
     # --- lifecycle ---
 
     def shutdown(self) -> None:

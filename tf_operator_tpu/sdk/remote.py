@@ -25,6 +25,16 @@ class ApiError(RuntimeError):
         self.code = code
 
 
+class HealthReport(dict):
+    """The aggregated /healthz report.  A plain dict except that its
+    truthiness is the *ready* verdict, so code written against the old
+    `healthz() -> bool` contract (`if cluster.healthz(): ...`) keeps
+    working — a non-empty-but-not-ready report must not read as healthy."""
+
+    def __bool__(self) -> bool:
+        return bool(self.get("ready"))
+
+
 class RemoteCluster(ClusterInterface):
     def __init__(self, base_url: str = "http://127.0.0.1:8008", timeout: float = 10.0):
         self.base_url = base_url.rstrip("/")
@@ -149,8 +159,50 @@ class RemoteCluster(ClusterInterface):
             for item in data.get("items", [])
         ]
 
-    def healthz(self) -> bool:
+    def healthz(self) -> "HealthReport":
+        """The operator's aggregated health report (docs/self-healing.md):
+        at least {"live": bool, "ready": bool}, plus worker/queue/watch/
+        quarantine detail from a controller-wired server.  Not-ready servers
+        answer 503 with the same JSON body, so that path parses the body
+        rather than surfacing an error; an unreachable server reports
+        {"live": False, "ready": False, "error": ...}.  Old servers that
+        answer a bare {"status": "ok"} JSON or plain-text "ok" body are
+        mapped onto the same shape; any other unparseable body (a proxy's
+        HTML error page, say) becomes the not-live error shape rather than
+        an exception.  The returned HealthReport is a dict whose truthiness
+        is the ready verdict, so `if cluster.healthz():` keeps its old
+        bool-contract meaning."""
+        url = f"{self.base_url}/healthz"
+        req = urllib.request.Request(url, method="GET")
         try:
-            return self._request("GET", "/healthz").get("status") == "ok"
-        except (OSError, ApiError):
-            return False
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                body = resp.read()
+            try:
+                report = json.loads(body or b"{}")
+            except json.JSONDecodeError:
+                report = None
+            if not isinstance(report, dict):
+                # plain-text "ok" (or the JSON string "ok") is a legacy
+                # healthy answer; any other non-object body is an error
+                if body.strip().strip(b'"').lower() == b"ok":
+                    report = {"status": "ok"}
+                else:
+                    return HealthReport(
+                        live=False, ready=False,
+                        error="unparseable healthz body: "
+                              f"{body.decode(errors='replace')[:200]}")
+        except urllib.error.HTTPError as err:
+            body = err.read()
+            try:
+                report = json.loads(body or b"{}")
+            except json.JSONDecodeError:
+                report = None
+            if not isinstance(report, dict):
+                report = {"error": f"HTTP {err.code}: "
+                                   f"{body.decode(errors='replace')[:200]}"}
+        except OSError as err:
+            return HealthReport(live=False, ready=False, error=str(err))
+        ok = report.get("status") == "ok"
+        report.setdefault("live", ok)
+        report.setdefault("ready", ok)
+        return HealthReport(report)

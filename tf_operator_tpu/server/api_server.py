@@ -26,6 +26,7 @@ from urllib.parse import parse_qs, urlparse
 
 from ..api.serialization import job_from_dict, job_to_dict
 from ..runtime.cluster import AlreadyExists, ClusterInterface, NotFound
+from .probes import probe_response
 
 _JOB_RE = re.compile(r"^/apis/v1/namespaces/([^/]+)/tpujobs(?:/([^/]+))?$")
 _POD_RE = re.compile(r"^/apis/v1/namespaces/([^/]+)/pods(?:/([^/]+)(/log)?)?$")
@@ -57,7 +58,7 @@ def _pod_to_dict(pod) -> dict:
     }
 
 
-def make_handler(cluster: ClusterInterface):
+def make_handler(cluster: ClusterInterface, health_provider=None):
     class ApiHandler(BaseHTTPRequestHandler):
         server_version = "tpu-operator-api"
 
@@ -132,8 +133,13 @@ def make_handler(cluster: ClusterInterface):
                 ]})
                 return
 
-            if parsed.path == "/healthz":
-                self._send(200, {"status": "ok"})
+            if parsed.path in ("/healthz", "/livez", "/readyz"):
+                # Deep health when a controller is wired (docs/self-healing.md):
+                # the aggregated live/ready report, with the status code per
+                # the k8s probe contract (probes.probe_response, shared with
+                # the monitoring port).  Provider-less servers (bare API over
+                # a cluster) stay ok.
+                self._send(*probe_response(parsed.path, health_provider))
                 return
             self._send_error(404, f"unknown path {parsed.path}")
 
@@ -189,8 +195,10 @@ def make_handler(cluster: ClusterInterface):
 
 
 def start_api_server(cluster: ClusterInterface, port: int,
-                     host: str = "127.0.0.1") -> ThreadingHTTPServer:
-    server = ThreadingHTTPServer((host, port), make_handler(cluster))
+                     host: str = "127.0.0.1",
+                     health_provider=None) -> ThreadingHTTPServer:
+    server = ThreadingHTTPServer(
+        (host, port), make_handler(cluster, health_provider=health_provider))
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name="tpujob-api-server")
     thread.start()

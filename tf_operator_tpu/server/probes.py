@@ -1,0 +1,42 @@
+"""The k8s probe contract, shared by both HTTP surfaces.
+
+The monitoring port (server.MonitoringHandler) and the REST API port
+(api_server.make_handler) both expose /healthz, /livez, and /readyz; the
+three paths serve the same aggregated health report
+(TPUJobController.health_report, docs/self-healing.md) and differ only in
+which verdict picks the status code.  One implementation here keeps the
+two ports from diverging in probe behavior.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+from ..utils import logging as tpulog
+
+log = tpulog.logger_for_key("health-probe")
+
+
+def probe_response(path: str,
+                   health_provider: Optional[Callable[[], dict]],
+                   ) -> Tuple[int, dict]:
+    """(status_code, report) for a probe request.
+
+    /livez answers 503 only when not live — liveness probes belong here; a
+    live-but-not-ready controller (leader-election standby, hung sync) must
+    fail readiness, not get restarted.  /readyz and /healthz answer 503
+    while not ready.  A provider-less server (no controller wired) is
+    trivially ok, and a provider that *raises* is reported as a failed
+    probe rather than killing the handler thread mid-response.
+    """
+    if health_provider is None:
+        report: dict = {"status": "ok", "live": True, "ready": True}
+    else:
+        try:
+            report = health_provider()
+        except Exception as err:  # noqa: BLE001 — probe must answer, not die
+            log.warning("health provider failed: %s", err)
+            report = {"live": False, "ready": False,
+                      "error": f"health provider failed: {err}"}
+    verdict = (report.get("live") if path == "/livez"
+               else report.get("ready"))
+    return (200 if verdict else 503), report

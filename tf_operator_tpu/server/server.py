@@ -19,6 +19,8 @@ from typing import Optional
 
 from .. import __version__
 from ..controller.controller import TPUJobController
+from ..controller.health import SelfHealingConfig
+from .probes import probe_response
 from ..runtime.cluster import ClusterInterface, InMemoryCluster
 from ..runtime.local import LocalProcessCluster
 from ..runtime.reconciler import ReconcilerConfig
@@ -92,6 +94,21 @@ def build_arg_parser() -> argparse.ArgumentParser:
                              "<=0 disables throttling (ref: options.go:81)")
     parser.add_argument("--burst", type=int, default=10,
                         help="maximum burst for throttle (ref: options.go:82)")
+    # Self-healing knobs (docs/self-healing.md; no reference analogue — the
+    # reference controller cannot observe its own failure modes at all).
+    parser.add_argument("--quarantine-threshold", type=int, default=5,
+                        help="consecutive sync failures before a job is "
+                             "quarantined out of the hot queue")
+    parser.add_argument("--quarantine-probation", type=float, default=60.0,
+                        help="seconds a quarantined job waits between sync "
+                             "probes (spec changes and resync ticks probe "
+                             "earlier)")
+    parser.add_argument("--stuck-sync-deadline", type=float, default=60.0,
+                        help="seconds after which an in-flight sync is "
+                             "reported stuck (flips /healthz to not-ready)")
+    parser.add_argument("--watch-stale-deadline", type=float, default=300.0,
+                        help="seconds without any watch event/heartbeat "
+                             "before a watch stream is force-reconnected")
     return parser
 
 
@@ -102,11 +119,30 @@ class MonitoringHandler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             body = metrics.REGISTRY.render().encode()
             ctype = "text/plain; version=0.0.4"
-        elif self.path == "/healthz":
-            body = b"ok"
-            ctype = "text/plain"
+        elif self.path in ("/healthz", "/livez", "/readyz"):
+            # Deep health (docs/self-healing.md): the controller's aggregated
+            # live/ready report — workers, hung syncs, watch freshness, queue
+            # pressure, quarantine, degraded episodes.  Status codes per the
+            # k8s probe contract (see probes.probe_response, shared with the
+            # REST API port).
+            provider = getattr(self.server, "health_provider", None)
+            code, report = probe_response(self.path, provider)
+            body = json.dumps(report).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         elif self.path == "/debug/threads":
             # The pprof-parity endpoint (ref: main.go:21 net/http/pprof).
+            # Loopback-only: the server binds all interfaces so the kubelet
+            # can probe and Prometheus can scrape, but stack traces are a
+            # debugging surface, not a pod-network one.
+            if self.client_address[0] not in ("127.0.0.1", "::1"):
+                self.send_response(403)
+                self.end_headers()
+                return
             import sys, traceback  # noqa: E401
 
             frames = sys._current_frames()
@@ -132,9 +168,17 @@ class MonitoringHandler(BaseHTTPRequestHandler):
         pass
 
 
-def start_monitoring(port: int) -> ThreadingHTTPServer:
-    """(ref: startMonitoring, main.go:39-50)"""
-    server = ThreadingHTTPServer(("127.0.0.1", port), MonitoringHandler)
+def start_monitoring(port: int, host: str = "0.0.0.0",
+                     health_provider=None) -> ThreadingHTTPServer:
+    """(ref: startMonitoring, main.go:39-50).  `health_provider` is a
+    zero-arg callable returning the aggregated health report
+    (TPUJobController.health_report); /healthz falls back to a static ok
+    without one.  Port 0 binds an ephemeral port (tests).  Binds all
+    interfaces by default: the kubelet probes /healthz and /livez via the
+    pod IP (manifests/deployment.yaml), which a loopback-only bind would
+    refuse — turning the livenessProbe into a restart loop."""
+    server = ThreadingHTTPServer((host, port), MonitoringHandler)
+    server.health_provider = health_provider
     thread = threading.Thread(target=server.serve_forever, daemon=True,
                               name="tpujob-monitoring")
     thread.start()
@@ -262,10 +306,17 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
         gang_mechanism=args.gang_mechanism,
     )
     resolver_owner = cluster if hasattr(cluster, "resolver") else None
+    healing = SelfHealingConfig(
+        quarantine_threshold=args.quarantine_threshold,
+        quarantine_probation=args.quarantine_probation,
+        stuck_sync_deadline=args.stuck_sync_deadline,
+        watch_stale_deadline=args.watch_stale_deadline,
+    )
     controller = TPUJobController(
         cluster,
         config=config,
         threadiness=args.threadiness,
+        healing=healing,
         **({"resolver": resolver_owner.resolver} if resolver_owner else {}),
     )
     if getattr(args, "slice_inventory", None) and not gang_in_process:
@@ -323,14 +374,24 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
         signal_mod.signal(signal_mod.SIGTERM, _handle_signal)
         signal_mod.signal(signal_mod.SIGINT, _handle_signal)
 
-    monitoring = start_monitoring(args.monitoring_port)
-    log.info("monitoring on 127.0.0.1:%d (/metrics /healthz /debug/threads)",
+    # With leader election a replica may sit not-started waiting for the
+    # lease; that standby is healthy by design and must report ready, or a
+    # readinessProbe keeps the Deployment's rollout NotReady forever.
+    if args.enable_leader_election:
+        def health_provider() -> dict:
+            return controller.health_report(standby_ok=True)
+    else:
+        health_provider = controller.health_report
+    monitoring = start_monitoring(args.monitoring_port,
+                                  health_provider=health_provider)
+    log.info("monitoring on 0.0.0.0:%d (/metrics /healthz /debug/threads)",
              args.monitoring_port)
     api = None
     if args.api_port:
         from .api_server import start_api_server
 
-        api = start_api_server(cluster, args.api_port)
+        api = start_api_server(cluster, args.api_port,
+                               health_provider=health_provider)
         log.info("REST API on 127.0.0.1:%d", args.api_port)
 
     if args.enable_leader_election:

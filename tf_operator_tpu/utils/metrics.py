@@ -186,3 +186,34 @@ api_giveups = REGISTRY.counter(
     "tpujob_api_giveups_total",
     "Apiserver requests abandoned after exhausting the retry budget",
 )
+# Self-healing layer (controller/health.py + the tpujob-watchdog thread):
+# the controller's own failure modes made observable — queue pressure,
+# poison-job quarantine, hung syncs, dead-worker respawns and stale watch
+# streams.  docs/self-healing.md documents the tuning knobs and how these
+# feed the live/ready verdicts on /healthz.
+queue_depth = REGISTRY.gauge(
+    "tpujob_queue_depth",
+    "Keys waiting in the controller work queue (sampled by the watchdog)",
+)
+quarantined_jobs = REGISTRY.gauge(
+    "tpujob_quarantined_jobs",
+    "Jobs currently quarantined after repeated consecutive sync failures",
+)
+worker_restarts = REGISTRY.counter(
+    "tpujob_worker_restarts_total",
+    "Sync worker threads respawned by the watchdog after dying",
+)
+stuck_syncs = REGISTRY.gauge(
+    "tpujob_stuck_syncs",
+    "In-flight syncs older than the watchdog's stuck-sync deadline",
+)
+stuck_sync_age = REGISTRY.gauge(
+    "tpujob_stuck_sync_age_seconds",
+    "Age of the oldest in-flight sync past the stuck-sync deadline "
+    "(0 when none is stuck)",
+)
+watch_stale_total = REGISTRY.counter(
+    "tpujob_watch_stale_total",
+    "Watch streams force-reconnected after going heartbeat-stale",
+    ("watch",),
+)
