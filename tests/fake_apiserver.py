@@ -56,6 +56,18 @@ class FakeApiServer:
         self._lock = threading.RLock()
         # (kind, namespace) -> name -> object dict
         self._store: Dict[Tuple[str, str], Dict[str, dict]] = {}
+        # label index: (kind, ns) -> (label key, value) -> name set.  A
+        # labelSelector LIST is the intersection of its pairs' sets — an
+        # object matches a conjunctive equality selector iff it appears in
+        # every pair's set — so the 1k-job bench measures the controller,
+        # not this fake's O(all pods) scans.  _indexed_pairs remembers the
+        # exact pairs each name is filed under, because _put callers mutate
+        # stored objects in place (set_pod_status) and the "old labels"
+        # cannot be re-read from the object at reindex time.
+        self._label_index: Dict[Tuple[str, str],
+                                Dict[Tuple[str, str], set]] = {}
+        self._indexed_pairs: Dict[Tuple[str, str],
+                                  Dict[str, set]] = {}
         self._rv = 0
         self._watchers: List[Tuple[str, "queue.Queue"]] = []
         # bounded (rv, kind, event) log: a watch with ?resourceVersion=N
@@ -302,26 +314,66 @@ class FakeApiServer:
         return self._store.get((kind, ns or "default"), {}).get(name)
 
     def _list(self, kind: str, ns: Optional[str], params: Dict[str, str]) -> List[dict]:
-        buckets = (
-            [self._store.get((kind, ns), {})]
-            if ns
-            else [v for (k, _), v in self._store.items() if k == kind]
-        )
-        items = [obj for bucket in buckets for obj in bucket.values()]
         selector = params.get("labelSelector")
-        if selector:
-            want = dict(kv.split("=", 1) for kv in selector.split(","))
-            items = [
-                o for o in items
-                if all(((o.get("metadata") or {}).get("labels") or {}).get(k) == v
-                       for k, v in want.items())
-            ]
+        want = (dict(kv.split("=", 1) for kv in selector.split(","))
+                if selector else None)
+        namespaces = ([ns] if ns
+                      else [bns for (k, bns) in self._store if k == kind])
+        items: List[dict] = []
+        for bns in namespaces:
+            items.extend(self._select(kind, bns, want))
         field = params.get("fieldSelector")
         if field and field.startswith("involvedObject.name="):
             target = field.split("=", 1)[1]
             items = [o for o in items
                      if (o.get("involvedObject") or {}).get("name") == target]
         return items
+
+    def _select(self, kind: str, ns: str, want: Optional[Dict[str, str]]) -> List[dict]:
+        """One namespace bucket's objects matching the (conjunctive
+        equality) selector, served from the label index."""
+        bucket = self._store.get((kind, ns), {})
+        if not want:
+            return list(bucket.values())
+        index = self._label_index.get((kind, ns), {})
+        names: Optional[set] = None
+        for pair in want.items():
+            matched = index.get(pair)
+            if not matched:
+                return []
+            names = set(matched) if names is None else names & matched
+            if not names:
+                return []
+        return [bucket[n] for n in names if n in bucket]
+
+    def _scan_select(self, kind: str, ns: str,
+                     want: Optional[Dict[str, str]]) -> List[dict]:
+        """Reference implementation of _select: the pre-index linear scan.
+        Kept for the conformance test that pins index == scan."""
+        return [
+            o for o in self._store.get((kind, ns), {}).values()
+            if all(((o.get("metadata") or {}).get("labels") or {}).get(k) == v
+                   for k, v in (want or {}).items())
+        ]
+
+    def _reindex(self, kind: str, ns: str, name: str, obj: Optional[dict]) -> None:
+        """Refile `name` under its current label pairs (obj=None removes)."""
+        index = self._label_index.setdefault((kind, ns), {})
+        filed = self._indexed_pairs.setdefault((kind, ns), {})
+        for pair in filed.pop(name, ()):  # drop the old filing
+            members = index.get(pair)
+            if members is not None:
+                members.discard(name)
+                if not members:
+                    del index[pair]
+        if obj is None:
+            return
+        pairs = {(k, v) for k, v in
+                 ((obj.get("metadata") or {}).get("labels") or {}).items()}
+        for pair in pairs:
+            index.setdefault(pair, set()).add(name)
+        if pairs:
+            filed[name] = pairs
 
     def _put(self, kind: str, ns: Optional[str], name: str, obj: dict,
              new: bool = False) -> dict:
@@ -335,6 +387,7 @@ class FakeApiServer:
             meta.setdefault("creationTimestamp", "2026-01-01T00:00:00Z")
         existed = name in self._store.setdefault((kind, ns), {})
         self._store[(kind, ns)][name] = obj
+        self._reindex(kind, ns, name, obj)
         self._notify(kind, "MODIFIED" if existed and not new else "ADDED", obj)
         return obj
 
@@ -342,6 +395,7 @@ class FakeApiServer:
         ns = ns or "default"
         obj = self._store.get((kind, ns), {}).pop(name, None)
         if obj is not None:
+            self._reindex(kind, ns, name, None)
             self._rv += 1
             self._notify(kind, "DELETED", obj)
 

@@ -17,7 +17,7 @@ import pytest
 
 from fake_apiserver import FakeApiServer
 from strict_apiserver import StrictApiServer
-from testutil import new_tpujob
+from testutil import new_tpujob, sync_until
 
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.core import (
@@ -110,10 +110,16 @@ def test_controller_drives_job_to_succeeded(k8s):
         {"name": "tensorflow", "state": {"terminated": {"exitCode": 0}}}]}
     for name in ("conf-e2e-worker-0", "conf-e2e-worker-1"):
         server.set_pod_status("default", name, done)
-    controller.sync_job("default/conf-e2e")
-    final = cluster.get_job("default", "conf-e2e")
-    assert any(c.type.value == "Succeeded" and c.status
-               for c in final.status.conditions), final.status.conditions
+
+    def succeeded():
+        return any(
+            c.type.value == "Succeeded" and c.status
+            for c in cluster.get_job("default", "conf-e2e").status.conditions)
+
+    # re-sync until the informer has observed the kubelet writes (see
+    # testutil.sync_until)
+    assert sync_until(controller, "default/conf-e2e", succeeded), \
+        cluster.get_job("default", "conf-e2e").status.conditions
     assert any(e.reason == "TPUJobSucceeded"
                for e in cluster.list_events(object_name="conf-e2e"))
 
@@ -319,11 +325,12 @@ def test_elastic_scale_over_the_wire(k8s):
     got = cluster.get_job("default", "conf-elastic")
     got.spec.replica_specs[ReplicaType.WORKER].replicas = 3
     cluster.update_job(got)
-    controller.sync_job("default/conf-elastic")
+    assert sync_until(
+        controller, "default/conf-elastic",
+        lambda: sorted(server.objects("pods")) == [
+            "conf-elastic-worker-0", "conf-elastic-worker-1",
+            "conf-elastic-worker-2"]), sorted(server.objects("pods"))
     pods = server.objects("pods")
-    assert sorted(pods) == [
-        "conf-elastic-worker-0", "conf-elastic-worker-1",
-        "conf-elastic-worker-2"]
     env = {e["name"]: e["value"]
            for e in pods["conf-elastic-worker-2"]["spec"]["containers"][0]["env"]}
     assert "TF_CONFIG" in env and '"index": 2' in env["TF_CONFIG"].replace(
@@ -332,5 +339,70 @@ def test_elastic_scale_over_the_wire(k8s):
     got = cluster.get_job("default", "conf-elastic")
     got.spec.replica_specs[ReplicaType.WORKER].replicas = 1
     cluster.update_job(got)
-    controller.sync_job("default/conf-elastic")
-    assert sorted(server.objects("pods")) == ["conf-elastic-worker-0"]
+    assert sync_until(
+        controller, "default/conf-elastic",
+        lambda: sorted(server.objects("pods")) == ["conf-elastic-worker-0"]), \
+        sorted(server.objects("pods"))
+
+
+# ---------------------------------------------------------------------------
+# fake-apiserver label index: the indexed LIST path must agree exactly with
+# the pre-index linear scan it replaced, through label churn and deletes —
+# so the 1k-job bench measures the controller, not an O(N) fixture scan,
+# without changing a single answer.
+
+
+def test_fake_label_index_agrees_with_scan():
+    server = FakeApiServer()
+    server.start()
+    for i in range(40):
+        labels = {"group": f"g{i % 4}", "parity": "even" if i % 2 == 0
+                  else "odd"}
+        if i % 5 == 0:
+            labels["fifth"] = "true"
+        if i % 7 == 0:
+            labels = {}  # unlabeled objects must stay out of the index
+        ns = "default" if i % 3 else "team-b"
+        server._put("pods", ns, f"ix-{i}", {
+            "apiVersion": "v1", "kind": "Pod",
+            "metadata": {"name": f"ix-{i}", "namespace": ns,
+                         "labels": labels},
+        }, new=True)
+
+    selectors = [None, {"group": "g0"}, {"group": "g1", "parity": "odd"},
+                 {"fifth": "true"}, {"group": "g2", "fifth": "true"},
+                 {"absent": "x"}, {"group": "g0", "absent": "x"}]
+
+    def check_all():
+        for ns in ("default", "team-b", "empty-ns"):
+            for want in selectors:
+                indexed = sorted(o["metadata"]["name"]
+                                 for o in server._select("pods", ns, want))
+                scanned = sorted(o["metadata"]["name"]
+                                 for o in server._scan_select("pods", ns, want))
+                assert indexed == scanned, (ns, want, indexed, scanned)
+
+    check_all()
+
+    # label churn: in-place mutation + _put (the set_pod_status shape)
+    with server._lock:
+        pod = server._get("pods", "default", "ix-1")
+        pod["metadata"]["labels"] = {"group": "g9"}
+        server._put("pods", "default", "ix-1", pod)
+    selectors.append({"group": "g9"})
+    check_all()
+
+    # deletes drop index entries
+    server._delete("pods", "default", "ix-1")
+    server._delete("pods", "team-b", "ix-0")
+    check_all()
+
+    # and the HTTP LIST path (what the controller actually hits) matches a
+    # scan too, including multi-pair selectors
+    items = server._list("pods", "default",
+                         {"labelSelector": "group=g1,parity=odd"})
+    assert sorted(o["metadata"]["name"] for o in items) == sorted(
+        o["metadata"]["name"]
+        for o in server._scan_select("pods", "default",
+                                     {"group": "g1", "parity": "odd"}))
+    server.stop()

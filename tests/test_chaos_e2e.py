@@ -265,8 +265,12 @@ def test_chaos_over_in_memory_cluster():
     """FaultyCluster injects at the ClusterInterface boundary: no HTTP, no
     retry layer — the controller's own requeue/expectation handling must
     absorb the faults."""
+    # rate 0.4, not the wire tests' 0.15: the informer collapsed the
+    # controller's read traffic, so the faultable call volume here is just
+    # the writes (pod/service creates, status patches) — a low rate would
+    # often inject nothing at all and the trace assertion below would flake.
     seed = 424242
-    injector = FaultInjector(FaultPlan(seed=seed, rate=0.15,
+    injector = FaultInjector(FaultPlan(seed=seed, rate=0.4,
                                        latency_range=(0.0, 0.005)))
     inner = InMemoryCluster()
     cluster = FaultyCluster(inner, injector)
@@ -476,8 +480,10 @@ def test_hung_sync_flags_watchdog_and_flips_healthz():
     reconciling healthy jobs — and once the hang clears, health returns to
     ready."""
     hang = 1.2
+    # The hang is injected on create_pod, a wire-path call: get_job is
+    # served by the informer cache now and never reaches the substrate.
     rules = [FaultRule(fault=Fault(FAULT_LATENCY, latency=hang),
-                       op="get_job", path="default/slow", times=1)]
+                       op="create_pod", path="slow", times=1)]
     injector = FaultInjector(FaultPlan(rules=rules, rate=0.0))
     inner = InMemoryCluster()
     cluster = FaultyCluster(inner, injector)

@@ -1,0 +1,447 @@
+"""Informer cache (runtime/informer.py): store correctness, index parity
+with direct LISTs, relist repair after lost events, and the acceptance
+property of ROADMAP item 1 — with the informer on, per-sync apiserver
+GET/LIST traffic collapses by >=10x, asserted on deterministic client
+request counters rather than wall-clock.
+"""
+import time
+
+from fake_apiserver import FakeApiServer
+from testutil import new_tpujob, start_kubelet_sim
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.core import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodTemplateSpec,
+    Service,
+)
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.runtime.cluster import InMemoryCluster, NotFound
+from tf_operator_tpu.runtime.faults import (
+    FAULT_GONE,
+    Fault,
+    FaultInjector,
+    FaultPlan,
+    FaultRule,
+)
+from tf_operator_tpu.runtime.informer import InformerCache
+from tf_operator_tpu.runtime.k8s import (
+    KubeConfig,
+    KubernetesCluster,
+    RetryPolicy,
+)
+from tf_operator_tpu.runtime.reconciler import ReconcilerConfig, gen_labels
+
+
+def wait_for(predicate, timeout=30.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def new_pod(name, namespace="default", labels=None):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=namespace,
+                            labels=dict(labels or {})),
+        spec=PodTemplateSpec(containers=[
+            Container(name="tensorflow", image="img")]),
+    )
+
+
+def new_service(name, namespace="default", labels=None):
+    return Service(
+        metadata=ObjectMeta(name=name, namespace=namespace,
+                            labels=dict(labels or {})),
+        selector=dict(labels or {}),
+    )
+
+
+# ---------------------------------------------------------------------------
+# store semantics over synchronous watches (InMemoryCluster)
+
+
+def test_watch_fed_add_update_delete():
+    cluster = InMemoryCluster()
+    inf = InformerCache(cluster, relist_period=0)
+
+    job = new_tpujob(worker=1, name="inf-job")
+    cluster.create_job(job)
+    assert inf.get_job("default", "inf-job") is job
+    assert [j.metadata.name for j in inf.list_jobs()] == ["inf-job"]
+
+    pod = new_pod("inf-pod", labels={"a": "1"})
+    cluster.create_pod(pod)
+    assert inf.list_pods("default", selector={"a": "1"}) == [pod]
+
+    # update: a MODIFIED event replaces the stored object and its filing
+    pod.metadata.labels["a"] = "2"
+    cluster.update_pod(pod)
+    assert inf.list_pods("default", selector={"a": "1"}) == []
+    assert inf.list_pods("default", selector={"a": "2"}) == [pod]
+
+    svc = new_service("inf-svc", labels={"s": "x"})
+    cluster.create_service(svc)
+    assert inf.list_services("default", selector={"s": "x"}) == [svc]
+
+    cluster.delete_pod("default", "inf-pod")
+    cluster.delete_service("default", "inf-svc")
+    cluster.delete_job("default", "inf-job")
+    assert inf.list_pods() == [] and inf.list_services() == []
+    # miss falls back to the wire, whose NotFound is authoritative
+    try:
+        inf.get_job("default", "inf-job")
+        raise AssertionError("expected NotFound")
+    except NotFound:
+        pass
+    counters = inf.counters()
+    assert counters["misses"] >= 1 and counters["hits"] >= 1
+
+
+def test_prime_fills_store_for_preexisting_objects():
+    cluster = InMemoryCluster()
+    cluster.create_job(new_tpujob(worker=1, name="pre-job"))
+    cluster.create_pod(new_pod("pre-pod"))
+    inf = InformerCache(cluster, relist_period=0)
+    assert inf.get_job("default", "pre-job").metadata.name == "pre-job"
+    assert len(inf.list_pods("default")) == 1
+    # the pre-existing read was a hit (prime filled the store), not a miss
+    assert inf.counters()["misses"] == 0
+
+
+def test_owner_index_matches_direct_list():
+    """The by-owner/by-namespace indexes must agree with the substrate's
+    own label-selected LISTs for the selector shapes the reconciler uses,
+    across namespaces and label churn."""
+    cluster = InMemoryCluster()
+    inf = InformerCache(cluster, relist_period=0)
+    for ns in ("default", "team-a"):
+        for j in range(3):
+            labels = dict(gen_labels(f"job-{j}"),
+                          **{constants.LABEL_REPLICA_TYPE: "worker",
+                             constants.LABEL_REPLICA_INDEX: "0"})
+            cluster.create_pod(new_pod(f"p-{ns}-{j}", namespace=ns,
+                                       labels=labels))
+            cluster.create_service(new_service(f"s-{ns}-{j}", namespace=ns,
+                                               labels=labels))
+    # unlabeled noise must not leak into selected lists
+    cluster.create_pod(new_pod("noise", namespace="default"))
+
+    for ns in ("default", "team-a", None):
+        for j in range(3):
+            selector = gen_labels(f"job-{j}")
+            want = sorted(p.metadata.name
+                          for p in cluster.list_pods(ns, selector=selector))
+            got = sorted(p.metadata.name
+                         for p in inf.list_pods(ns, selector=selector))
+            assert got == want, (ns, j, got, want)
+            want_s = sorted(s.metadata.name
+                            for s in cluster.list_services(ns, selector=selector))
+            got_s = sorted(s.metadata.name
+                           for s in inf.list_services(ns, selector=selector))
+            assert got_s == want_s
+        assert (sorted(p.metadata.name for p in inf.list_pods(ns))
+                == sorted(p.metadata.name for p in cluster.list_pods(ns)))
+
+
+def test_relist_repairs_store_after_lost_events():
+    """The repair path: a watch that silently loses events (simulated by
+    detaching the informer's handlers) leaves the store diverged; one
+    relist pass restores exact parity — upserts for new objects, removals
+    for deleted ones."""
+    cluster = InMemoryCluster()
+    inf = InformerCache(cluster, relist_period=0)
+    cluster.create_pod(new_pod("keep"))
+    cluster.create_pod(new_pod("doomed"))
+    assert len(inf.list_pods("default")) == 2
+
+    # the stream goes blind: events stop reaching the informer
+    cluster._pod_handlers.remove(inf._on_pod)
+    cluster.delete_pod("default", "doomed")
+    cluster.create_pod(new_pod("born-blind"))
+    stale = sorted(p.metadata.name for p in inf.list_pods("default"))
+    assert stale == ["doomed", "keep"], "test setup: store must be stale"
+
+    before = inf.counters()["relists"]
+    inf.relist()
+    repaired = sorted(p.metadata.name for p in inf.list_pods("default"))
+    assert repaired == ["born-blind", "keep"]
+    assert inf.counters()["relists"] == before + 3  # jobs+pods+services
+
+
+def test_relist_loop_triggered_by_relist_soon():
+    cluster = InMemoryCluster()
+    inf = InformerCache(cluster, relist_period=3600.0)  # never on its own
+    inf.start_relist()
+    try:
+        cluster._pod_handlers.remove(inf._on_pod)
+        cluster.create_pod(new_pod("missed"))
+        assert inf.list_pods("default") == []
+        inf.relist_soon()  # what the watchdog calls after a stale-watch kick
+        assert wait_for(lambda: len(inf.list_pods("default")) == 1, timeout=10)
+    finally:
+        inf.stop()
+
+
+# ---------------------------------------------------------------------------
+# over the wire: dropped watches + the traffic-collapse acceptance gate
+
+
+def test_cache_correct_despite_scripted_watch_drops():
+    """Scripted FaultRules kill the pods watch stream repeatedly (410 Gone
+    on every other establishment); the list-then-watch machinery plus the
+    informer must still converge the cache to server truth."""
+    server = FakeApiServer()
+    url = server.start()
+    rules = [FaultRule(fault=Fault(FAULT_GONE), scope="watch",
+                       path="pods", times=3)]
+    injector = FaultInjector(FaultPlan(rules=rules, rate=0.0))
+    cluster = KubernetesCluster(
+        KubeConfig(host=url, namespace="default"), namespace="default",
+        qps=0, retry=RetryPolicy(max_retries=2, base_delay=0.01,
+                                 max_delay=0.05, deadline=5.0),
+        fault_injector=injector)
+    try:
+        inf = InformerCache(cluster, relist_period=0)
+        for i in range(3):
+            cluster.create_pod(new_pod(f"wire-{i}", labels={"w": "1"}))
+        assert wait_for(
+            lambda: sorted(p.metadata.name
+                           for p in inf.list_pods("default",
+                                                  selector={"w": "1"}))
+            == ["wire-0", "wire-1", "wire-2"], timeout=30), \
+            sorted(p.metadata.name for p in inf.list_pods("default"))
+        assert injector.trace, "the watch-drop rules never fired"
+    finally:
+        cluster.close()
+        server.stop()
+
+
+def _steady_state_reads(use_informer: bool, jobs: int = 8,
+                        window: float = 1.5):
+    """Bring `jobs` single-worker jobs to Running under a controller, then
+    measure non-watch GET traffic over a steady-state window of resync
+    ticks.  Returns reads observed in the window (client-side counter)."""
+    server = FakeApiServer()
+    url = server.start()
+    cluster = KubernetesCluster(
+        KubeConfig(host=url, namespace="default"), namespace="default", qps=0)
+    controller = TPUJobController(
+        cluster, config=ReconcilerConfig(reconciler_sync_loop_period=0.1),
+        threadiness=2, use_informer=use_informer,
+        informer_relist_period=0 if use_informer else 300.0)
+    controller.start()
+    stop_kubelet = start_kubelet_sim(server)
+    try:
+        for i in range(jobs):
+            cluster.create_job(new_tpujob(worker=1, name=f"rd-{i}"))
+
+        def all_running():
+            tpujobs = server.objects("tpujobs")
+            if len(tpujobs) != jobs:
+                return False
+            running = 0
+            for obj in tpujobs.values():
+                for cond in ((obj.get("status") or {}).get("conditions")
+                             or []):
+                    if (cond.get("type") == "Running"
+                            and cond.get("status") in (True, "True")):
+                        running += 1
+                        break
+            return running == jobs
+
+        assert wait_for(all_running, timeout=60), \
+            f"jobs never all Running (informer={use_informer})"
+        time.sleep(0.3)  # let in-flight syncs from convergence drain
+        before = cluster.client.request_count("GET")
+        time.sleep(window)
+        return cluster.client.request_count("GET") - before
+    finally:
+        stop_kubelet()
+        controller.stop()
+        cluster.close()
+        server.stop()
+
+
+def test_informer_collapses_steady_state_reads_10x():
+    """The acceptance gate: same workload, same window — the informer-off
+    controller pays per-sync GET/LIST wire traffic every resync tick, the
+    informer-on controller pays ~none.  >=10x, on request counters."""
+    with_informer = _steady_state_reads(use_informer=True)
+    without_informer = _steady_state_reads(use_informer=False)
+    # informer-off floor: every 0.1s resync tick LISTs jobs and every job
+    # sync GETs the job + LISTs pods and services; 8 jobs over 1.5s is
+    # hundreds of reads.  Guard the floor so the ratio can't pass vacuously
+    # (e.g. a broken resync loop making both sides ~0).
+    assert without_informer >= 50, without_informer
+    assert without_informer >= 10 * max(with_informer, 1), (
+        f"informer-on: {with_informer} reads, "
+        f"informer-off: {without_informer} reads")
+
+
+# ---------------------------------------------------------------------------
+# health surface
+
+
+def test_health_report_has_informer_and_shard_sections():
+    cluster = InMemoryCluster()
+    controller = TPUJobController(cluster, threadiness=1, shards=2)
+    try:
+        report = controller.health_report()
+        assert report["informer"]["jobs"] == 0
+        assert report["informer"]["relist_period_seconds"] > 0
+        assert report["queue"]["num_shards"] == 2
+        assert len(report["queue"]["shards"]) == 2
+        for shard in report["queue"]["shards"]:
+            assert {"p50", "p95", "p99"} <= set(shard["latency"])
+        assert report["workers"]["expected"] == 2  # threadiness per shard
+    finally:
+        controller.stop()
+
+
+def test_no_informer_flag_restores_wire_reads():
+    cluster = InMemoryCluster()
+    controller = TPUJobController(cluster, use_informer=False)
+    try:
+        assert controller.informer is None
+        assert controller.reads is cluster
+        assert controller.health_report()["informer"] is None
+    finally:
+        controller.stop()
+
+
+def test_server_flags_for_scale_knobs():
+    from tf_operator_tpu.server.server import build_arg_parser
+
+    args = build_arg_parser().parse_args([])
+    assert args.reconcile_shards == 1       # exact pre-sharding behavior
+    assert args.informer_relist_period == 300.0
+    assert args.use_informer is True
+    args = build_arg_parser().parse_args(
+        ["--reconcile-shards", "8", "--informer-relist-period", "60",
+         "--no-informer"])
+    assert (args.reconcile_shards, args.informer_relist_period,
+            args.use_informer) == (8, 60.0, False)
+
+
+# ---------------------------------------------------------------------------
+# deletion-race hardening: the cache must never resurrect a deleted object
+
+
+def test_get_job_miss_does_not_write_back_to_store():
+    """The wire fallback must not populate the store: a GET racing a
+    DELETED watch event would otherwise resurrect the job as a permanent
+    hit and make the NotFound cleanup path unreachable."""
+    cluster = InMemoryCluster()
+    inf = InformerCache(cluster, relist_period=0)
+    cluster._job_handlers.remove(inf._on_job)  # blind stream: misses stay cold
+    cluster.create_job(new_tpujob(worker=1, name="cold"))
+    assert inf.get_job("default", "cold").metadata.name == "cold"  # via wire
+    assert len(inf.jobs) == 0, "fallback must not upsert"
+    assert inf.get_job("default", "cold") is not None
+    assert inf.counters()["misses"] == 2
+
+
+def test_tombstone_blocks_stale_snapshot_resurrection():
+    """A DELETED event processed after a LIST snapshot was taken wins over
+    merging/replaying that snapshot; a genuine recreate (watch upsert)
+    clears the tombstone."""
+    import time as _t
+
+    cluster = InMemoryCluster()
+    inf = InformerCache(cluster, relist_period=0)
+    pod = new_pod("ghost")
+    cluster.create_pod(pod)
+    snapshot_time = _t.monotonic()
+    cluster.delete_pod("default", "ghost")  # DELETED arrives post-snapshot
+    # applying the stale snapshot must NOT resurrect the pod
+    inf.pods.merge([pod], as_of=snapshot_time)
+    assert inf.list_pods("default") == []
+    inf.pods.replace_all([pod], as_of=snapshot_time)
+    assert inf.list_pods("default") == []
+    # a snapshot taken AFTER the deletion (fresh truth) does apply
+    inf.pods.merge([pod], as_of=_t.monotonic())
+    assert inf.list_pods("default") == [pod]
+    inf.pods.remove(pod)
+    # and a watch recreate clears the tombstone immediately
+    cluster.create_pod(new_pod("ghost"))
+    assert [p.metadata.name for p in inf.list_pods("default")] == ["ghost"]
+
+
+def test_snapshot_cannot_evict_or_revert_fresher_watch_state():
+    """The symmetric guard: applying a LIST snapshot must not evict an
+    object a watch event created after the snapshot was taken, nor revert
+    one a watch event updated after it."""
+    import copy
+    import time as _t
+
+    cluster = InMemoryCluster()
+    inf = InformerCache(cluster, relist_period=0)
+    pod = new_pod("veteran", labels={"v": "1"})
+    cluster.create_pod(pod)
+    snapshot = [copy.deepcopy(p) for p in cluster.list_pods()]
+    as_of = _t.monotonic()
+
+    # after the snapshot: one pod is created, one is updated, via watches
+    cluster.create_pod(new_pod("newborn"))
+    pod.metadata.labels["v"] = "2"
+    cluster.update_pod(pod)
+
+    inf.pods.replace_all(snapshot, as_of)
+    names = sorted(p.metadata.name for p in inf.list_pods("default"))
+    assert names == ["newborn", "veteran"], names  # newborn NOT evicted
+    veteran = inf.pods.get("default", "veteran")
+    assert veteran.metadata.labels["v"] == "2"     # update NOT reverted
+
+    # a genuinely newer snapshot still applies in full
+    fresh_snapshot = [copy.deepcopy(p) for p in cluster.list_pods()
+                      if p.metadata.name == "veteran"]
+    inf.pods.replace_all(fresh_snapshot, _t.monotonic())
+    assert [p.metadata.name for p in inf.list_pods("default")] == ["veteran"]
+
+
+def test_relist_soon_works_with_periodic_relist_disabled():
+    """--informer-relist-period<=0 disables the PERIODIC relist only: the
+    stale-watch-kick repair path (relist_soon) must still fire, or a blind
+    stream's lost deletions would never be repaired."""
+    cluster = InMemoryCluster()
+    inf = InformerCache(cluster, relist_period=0)
+    inf.start_relist()
+    try:
+        cluster._pod_handlers.remove(inf._on_pod)
+        cluster.create_pod(new_pod("missed-again"))
+        assert inf.list_pods("default") == []
+        inf.relist_soon()
+        assert wait_for(lambda: len(inf.list_pods("default")) == 1,
+                        timeout=10)
+    finally:
+        inf.stop()
+
+
+def test_orphan_claim_does_not_taint_cached_pods():
+    """Claiming an orphan pod is per-pass: the shared cached object must
+    not be stamped with the claiming job's uid, or a same-name successor
+    job (new uid) could never claim it."""
+    from tf_operator_tpu.runtime.reconciler import gen_general_name
+
+    cluster = InMemoryCluster()
+    controller = TPUJobController(cluster)
+    job = new_tpujob(worker=1, name="claimer")
+    job.metadata.uid = "uid-one"
+    orphan = new_pod(gen_general_name("claimer", "worker", 0),
+                     labels=dict(gen_labels("claimer"),
+                                 **{constants.LABEL_REPLICA_TYPE: "worker",
+                                    constants.LABEL_REPLICA_INDEX: "0"}))
+    cluster.create_pod(orphan)
+    claimed = controller.reconciler.get_pods_for_job(job)
+    assert [p.metadata.name for p in claimed] == [orphan.metadata.name]
+    assert orphan.metadata.owner_uid == "", "claim must not mutate the pod"
+    # a successor job under the same name claims it too
+    successor = new_tpujob(worker=1, name="claimer")
+    successor.metadata.uid = "uid-two"
+    assert len(controller.reconciler.get_pods_for_job(successor)) == 1
+    controller.stop()

@@ -11,7 +11,7 @@ import time
 import pytest
 
 from fake_apiserver import FakeApiServer
-from testutil import new_tpujob
+from testutil import new_tpujob, sync_until
 
 from tf_operator_tpu.api import constants
 from tf_operator_tpu.api.core import (
@@ -150,11 +150,16 @@ def test_controller_reconciles_through_apiserver(k8s):
     }
     for name in ("k8s-job-worker-0", "k8s-job-worker-1"):
         server.set_pod_status("default", name, done)
-    controller.sync_job("default/k8s-job")
-    final = cluster.get_job("default", "k8s-job")
-    assert any(
-        c.type.value == "Succeeded" and c.status for c in final.status.conditions
-    ), final.status.conditions
+
+    # re-sync until the informer cache has observed the kubelet-style
+    # status writes (see testutil.sync_until)
+    def succeeded():
+        final = cluster.get_job("default", "k8s-job")
+        return any(c.type.value == "Succeeded" and c.status
+                   for c in final.status.conditions)
+
+    assert sync_until(controller, "default/k8s-job", succeeded), \
+        cluster.get_job("default", "k8s-job").status.conditions
     events = cluster.list_events(object_name="k8s-job")
     assert any(e.reason == "TPUJobSucceeded" for e in events)
 

@@ -7,7 +7,12 @@ import pytest
 from tf_operator_tpu.api.types import JobConditionType
 from tf_operator_tpu.runtime import conditions
 from tf_operator_tpu.runtime.expectations import Expectations, expectation_key
-from tf_operator_tpu.runtime.workqueue import RateLimitingQueue, ShutDown
+from tf_operator_tpu.runtime.workqueue import (
+    RateLimitingQueue,
+    ShardedWorkQueue,
+    ShutDown,
+    shard_for,
+)
 from tf_operator_tpu.utils.metrics import REGISTRY, jobs_created
 
 from testutil import new_controller, new_tpujob
@@ -62,6 +67,102 @@ class TestWorkQueue:
         q.shutdown()
         t.join(timeout=1)
         assert result.get("shutdown")
+
+    def test_add_after_coalesces_to_earliest_deadline(self):
+        """Re-arming a pending key keeps the SOONEST delivery; later
+        deadlines are absorbed (one map entry, not one timer each)."""
+        q = RateLimitingQueue()
+        q.add_after("a", 10.0)     # far future
+        q.add_after("a", 0.05)     # sooner: must win
+        q.add_after("a", 30.0)     # later again: absorbed
+        assert q.stats()["pending_timers"] == 1
+        t0 = time.monotonic()
+        assert q.get(timeout=2) == "a"
+        assert time.monotonic() - t0 < 2.0  # the 0.05s deadline, not 10s
+        q.done("a")
+        # delivered exactly once; nothing still pending
+        with pytest.raises(TimeoutError):
+            q.get(timeout=0.1)
+        assert q.stats()["pending_timers"] == 0
+        q.shutdown()
+
+    def test_add_after_burst_spawns_no_timer_threads(self):
+        """A 5k-job resync/probation burst used to leak one threading.Timer
+        per call; the coalesced dispatcher keeps it at one thread total."""
+        q = RateLimitingQueue()
+        before = threading.active_count()
+        for i in range(2000):
+            q.add_after(f"job-{i}", 5.0 + (i % 7))
+        after = threading.active_count()
+        assert after - before <= 1, (before, after)
+        assert q.stats()["pending_timers"] == 2000
+        q.shutdown()
+
+    def test_latency_percentiles_in_stats(self):
+        q = RateLimitingQueue()
+        for i in range(10):
+            q.add(f"k{i}")
+        time.sleep(0.05)
+        for _ in range(10):
+            q.done(q.get(timeout=1))
+        stats = q.stats()
+        assert stats["delivered"] == 10
+        latency = stats["latency"]
+        assert 0.04 <= latency["p50"] <= latency["p95"] <= latency["p99"]
+        q.shutdown()
+
+
+class TestShardedWorkQueue:
+    def test_shard_for_is_stable_and_in_range(self):
+        keys = [f"ns/job-{i}" for i in range(200)]
+        first = [shard_for(k, 8) for k in keys]
+        assert first == [shard_for(k, 8) for k in keys]  # deterministic
+        assert all(0 <= s < 8 for s in first)
+        assert len(set(first)) > 1  # actually spreads
+        assert all(shard_for(k, 1) == 0 for k in keys)
+
+    def test_routing_keeps_per_key_semantics_within_one_shard(self):
+        q = ShardedWorkQueue(4)
+        key = "default/routed"
+        shard = q.shard_index(key)
+        q.add(key)
+        q.add(key)  # dedup
+        assert len(q.shard(shard)) == 1
+        assert all(len(q.shard(i)) == 0 for i in range(4) if i != shard)
+        got = q.shard(shard).get(timeout=1)
+        assert got == key
+        q.add(key)  # while processing: redeliver after done, same shard
+        q.done(key)
+        assert q.shard(shard).get(timeout=1) == key
+        q.done(key)
+        q.add_rate_limited(key)
+        assert q.num_requeues(key) == 1
+        q.forget(key)
+        assert q.num_requeues(key) == 0
+        q.shutdown()
+
+    def test_single_shard_delegates_to_one_queue(self):
+        """--reconcile-shards=1 must preserve today's behavior exactly:
+        one underlying RateLimitingQueue sees every operation."""
+        q = ShardedWorkQueue(1)
+        assert q.num_shards == 1 and len(q.shards) == 1
+        for key in ("a", "b", "c"):
+            q.add(key)
+        assert len(q) == len(q.shard(0)) == 3
+        assert q.shard_index("anything") == 0
+        stats = q.stats()
+        assert stats["depth"] == 3 and len(stats["shards"]) == 1
+        q.shutdown()
+
+    def test_aggregate_stats_sum_shards(self):
+        q = ShardedWorkQueue(3)
+        for i in range(30):
+            q.add(f"k-{i}")
+        stats = q.stats()
+        assert stats["depth"] == 30
+        assert stats["depth"] == sum(s["depth"] for s in stats["shards"])
+        assert {"p50", "p95", "p99"} <= set(stats["latency"])
+        q.shutdown()
 
 
 class TestExpectations:

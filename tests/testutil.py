@@ -149,6 +149,29 @@ def set_pods(cluster: InMemoryCluster, job: TPUJob, rtype: ReplicaType,
             index += 1
 
 
+def sync_until(controller, key, predicate, timeout: float = 10.0,
+               interval: float = 0.05):
+    """Drive `controller.sync_job(key)` by hand until `predicate()` holds.
+
+    Tests that call sync_job directly (no started worker loop) used to be
+    single-shot: every read hit the wire, so one sync saw fresh state.  The
+    controller now reads through its informer cache, which watch streams
+    update asynchronously — in production the same watch event that updates
+    the store also enqueues the key, so a started controller re-syncs
+    automatically; a hand-driven test must loop the same way.  Each pass is
+    cache-only and cheap.  Returns True once the predicate held."""
+    import time as _time
+
+    deadline = _time.time() + timeout
+    while True:
+        controller.sync_job(key)
+        if predicate():
+            return True
+        if _time.time() >= deadline:
+            return False
+        _time.sleep(interval)
+
+
 def new_controller(enable_gang: bool = False):
     """Controller wired to fakes (ref: controller_test.go:45-66)."""
     from tf_operator_tpu.runtime.reconciler import ReconcilerConfig

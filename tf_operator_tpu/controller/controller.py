@@ -2,11 +2,15 @@
 
 Re-architecture of the reference's TFController
 (/root/reference/pkg/controller.v1/tensorflow/controller.go,job.go,pod.go):
-watch handlers feed a rate-limited workqueue; N worker threads pop keys and
-run the generic reconcile engine with TPU-specific plugin hooks (topology
-injection, master-role labeling, success matrix).  Expectations gate syncs so
-a stale store view never causes duplicate pod creation
-(ref: controller.go:319,339-358).
+watch handlers feed a sharded, rate-limited workqueue; worker pools (one per
+shard, selected by stable key hash so a hot tenant's backoff storm cannot
+serialize other tenants) pop keys and run the generic reconcile engine with
+TPU-specific plugin hooks (topology injection, master-role labeling, success
+matrix).  Reads on the sync hot path come from a shared informer cache
+(runtime/informer.py, docs/informer-cache.md) instead of the wire, the
+client-go L0/L1 analogue that collapses per-sync apiserver traffic to ~zero;
+writes stay on the cluster.  Expectations gate syncs so a stale store view
+never causes duplicate pod creation (ref: controller.go:319,339-358).
 
 On top of the reference's loop sits a self-healing layer (controller/health.py,
 docs/self-healing.md): a `tpujob-watchdog` thread respawns dead workers,
@@ -39,12 +43,13 @@ from ..runtime import conditions
 from ..runtime.cluster import ClusterInterface, EventType, NotFound
 from ..runtime.control import RealPodControl, RealServiceControl
 from ..runtime.expectations import expectation_key
+from ..runtime.informer import DEFAULT_RELIST_PERIOD, InformerCache
 from ..runtime.reconciler import (
     JobPlugin,
     JobReconciler,
     ReconcilerConfig,
 )
-from ..runtime.workqueue import RateLimitingQueue, ShutDown
+from ..runtime.workqueue import ShardedWorkQueue, ShutDown
 from ..utils import clock, locks
 from ..utils import logging as tpulog
 from ..utils import metrics
@@ -91,12 +96,29 @@ class TPUJobController(JobPlugin):
         resolver: topology.AddressResolver = topology.dns_resolver,
         threadiness: int = 1,
         healing: Optional[SelfHealingConfig] = None,
+        shards: int = 1,
+        use_informer: bool = True,
+        informer_relist_period: float = DEFAULT_RELIST_PERIOD,
     ) -> None:
         self.controller_name = CONTROLLER_NAME
         self.cluster = cluster
         self.resolver = resolver
+        # `threadiness` is workers PER SHARD (with shards=1 — the default —
+        # it is the total, i.e. today's meaning, preserved exactly).
         self.threadiness = threadiness
-        self.work_queue = RateLimitingQueue()
+        self.num_shards = max(1, int(shards))
+        self.work_queue = ShardedWorkQueue(self.num_shards)
+        # The informer registers its watch handlers BEFORE ours below, so
+        # on every event the store is updated first and the enqueued key's
+        # sync reads a view that already includes that event.  Reads the
+        # hot path used to pay wire traffic for (get_job + the two
+        # label-selected lists per sync) come from it; writes stay on the
+        # cluster.  docs/informer-cache.md tells the whole story.
+        self.informer: Optional[InformerCache] = (
+            InformerCache(cluster, relist_period=informer_relist_period)
+            if use_informer else None
+        )
+        self.reads = self.informer if self.informer is not None else cluster
         self.pod_control = RealPodControl(cluster)
         self.service_control = RealServiceControl(cluster)
         self.reconciler = JobReconciler(
@@ -105,6 +127,7 @@ class TPUJobController(JobPlugin):
             service_control=self.service_control,
             plugin=self,
             config=config,
+            reads=self.reads,
         )
         self.expectations = self.reconciler.expectations
         self.healing = healing or SelfHealingConfig()
@@ -248,7 +271,9 @@ class TPUJobController(JobPlugin):
     def start(self) -> None:
         """Non-blocking run()."""
         self._started = True
-        for i in range(self.threadiness):
+        if self.informer is not None:
+            self.informer.start_relist()
+        for i in range(self.total_workers):
             self._spawn_worker(i)
         resync = threading.Thread(target=self._resync_loop, name="tpujob-resync", daemon=True)
         resync.start()
@@ -258,6 +283,18 @@ class TPUJobController(JobPlugin):
         watchdog.start()
         self._watchdog = watchdog
         self._aux_threads.append(watchdog)
+
+    @property
+    def total_workers(self) -> int:
+        """Worker threads across all shards (threadiness is per shard)."""
+        return self.threadiness * self.num_shards
+
+    def shard_of_worker(self, worker_id: int) -> int:
+        """Worker ids are grouped per shard: [0, threadiness) serve shard 0,
+        the next `threadiness` serve shard 1, and so on — a worker never
+        pulls from another shard's queue, which is the non-serialization
+        guarantee sharding exists for."""
+        return worker_id // self.threadiness
 
     def _spawn_worker(self, worker_id: int) -> None:
         thread = threading.Thread(target=self._run_worker, args=(worker_id,),
@@ -298,7 +335,11 @@ class TPUJobController(JobPlugin):
                 # the tick's enqueue below delivers it to a worker, which
                 # admits exactly one sync attempt (controller/health.py).
                 self.sync_health.grant_probes()
-                for job in self.cluster.list_jobs():
+                # The relist comes from the informer store when one runs:
+                # at 5k jobs a per-tick wire LIST is exactly the traffic
+                # the cache exists to collapse, and the informer's own
+                # relist loop keeps the store honest on its own cadence.
+                for job in self.reads.list_jobs():
                     self.work_queue.add(job.key())
             except Exception as err:  # noqa: BLE001 — transient; next tick retries
                 tpulog.logger_for_key("resync").warning(
@@ -348,6 +389,8 @@ class TPUJobController(JobPlugin):
     def stop(self) -> None:
         self._stop.set()
         self._resync_now.set()  # wake the resync loop out of its period wait
+        if self.informer is not None:
+            self.informer.stop()
         self.work_queue.shutdown()
         with self._workers_lock:
             workers = list(self._workers.values())
@@ -355,18 +398,28 @@ class TPUJobController(JobPlugin):
             t.join(timeout=5)
 
     def _run_worker(self, worker_id: int) -> None:
+        shard_queue = self.work_queue.shard(self.shard_of_worker(worker_id))
         while not self._stop.is_set():
             try:
-                key = self.work_queue.get(timeout=0.5)
+                key = shard_queue.get(timeout=0.5)
             except ShutDown:
                 return
             except TimeoutError:
                 continue
             try:
                 if not self.sync_health.admit(key):
-                    # Quarantined with no probe due: absorb the enqueue.  The
-                    # key comes back via resync probes, probation expiry, or
-                    # a spec change — never through the hot backoff path.
+                    # Quarantined with no probe due: absorb the enqueue,
+                    # then re-arm the probation wakeup.  The re-arm matters:
+                    # the delayed-delivery queue keeps only the EARLIEST
+                    # pending deadline per key, so the original probation
+                    # arm may have been coalesced away by a sooner delivery
+                    # (a TTL/deadline re-arm) — the one being absorbed right
+                    # now.  Without this, a parked key could end up with no
+                    # scheduled delivery at all and recovery would wait on
+                    # the resync backstop, which degraded mode widens
+                    # exactly when quarantines are most likely.
+                    self.work_queue.add_after(
+                        key, self.healing.quarantine_probation)
                     continue
                 self.sync_health.record_sync_start(worker_id, key)
                 synced = self.sync_job(key)
@@ -412,7 +465,10 @@ class TPUJobController(JobPlugin):
     def _sync_job(self, key: str) -> bool:
         namespace, _, name = key.partition("/")
         try:
-            job = self.cluster.get_job(namespace, name)
+            # Informer read: the steady-state sync costs the apiserver
+            # nothing.  A miss falls back to the wire inside the cache, so
+            # NotFound still means the job is really gone.
+            job = self.reads.get_job(namespace, name)
         except NotFound:
             # The job is gone: release every per-key residue — expectations,
             # rate-limiter backoff state, and any quarantine — or the maps
@@ -467,10 +523,13 @@ class TPUJobController(JobPlugin):
                 reason=JOB_STUCK_REASON,
                 message=message,
             ))
-            # deepcopy before mutating, like _sync_job: InMemoryCluster
-            # returns the live stored object, and a torn in-place condition
-            # write would race concurrent workers (and leak state on a
-            # failed update_job_status).
+            # Wire read, NOT the informer: this is a read-modify-write of
+            # status on a rare event, and a cache that hasn't seen our own
+            # recent writes yet would silently clobber them.  deepcopy
+            # before mutating, like _sync_job: InMemoryCluster returns the
+            # live stored object, and a torn in-place condition write would
+            # race concurrent workers (and leak state on a failed
+            # update_job_status).
             job = self.cluster.get_job(namespace, name).deepcopy()
             # Baseline for release-on-spec-change: MODIFIED events only
             # compare fingerprints for quarantined keys, against this.
@@ -493,6 +552,9 @@ class TPUJobController(JobPlugin):
         previously quarantined job (best-effort, like the marker)."""
         namespace, _, name = key.partition("/")
         try:
+            # Wire read for the same reason as _mark_job_stuck: a cache
+            # that predates our own Stuck write would report the condition
+            # absent and this retraction would silently never happen.
             job = self.cluster.get_job(namespace, name).deepcopy()
             if conditions.clear_condition(
                     job.status, JobConditionType.STUCK, JOB_RECOVERED_REASON,
@@ -569,12 +631,24 @@ class TPUJobController(JobPlugin):
                 # Delegate the relist to the resync thread: a stale watch
                 # usually means the apiserver is misbehaving, and a blocking
                 # list_jobs() here would wedge the watchdog itself through
-                # the client's whole retry budget.
+                # the client's whole retry budget.  The informer store gets
+                # the same treatment: whatever events the blind stream
+                # swallowed are repaired on ITS thread, immediately, not at
+                # the next relist period.
+                if self.informer is not None:
+                    self.informer.relist_soon()
                 self._resync_now.set()
 
-        # 4. Gauges the report and /metrics share.
+        # 4. Gauges the report and /metrics share.  tpujob_queue_depth stays
+        # the fleet aggregate; per-shard depth and enqueue->dequeue latency
+        # quantiles land on the sharded gauges.
         stats = self.work_queue.stats()
         metrics.queue_depth.labels().set(float(stats["depth"]))
+        for index, shard_stats in enumerate(stats["shards"]):
+            metrics.queue_shard_depth.labels(str(index)).set(
+                float(shard_stats["depth"]))
+            for quantile, value in shard_stats["latency"].items():
+                metrics.queue_latency.labels(str(index), quantile).set(value)
         metrics.quarantined_jobs.labels().set(
             float(self.sync_health.quarantine_count()))
 
@@ -601,8 +675,8 @@ class TPUJobController(JobPlugin):
             reasons.append("not-started: controller workers not running yet")
         if stopped:
             reasons.append("stopped: controller is shutting down")
-        if self._started and alive < self.threadiness:
-            reasons.append(f"workers: {alive}/{self.threadiness} alive")
+        if self._started and alive < self.total_workers:
+            reasons.append(f"workers: {alive}/{self.total_workers} alive")
 
         stuck = self.sync_health.stuck_syncs()
         for entry in stuck:
@@ -653,13 +727,20 @@ class TPUJobController(JobPlugin):
             "reasons": reasons,
             "timestamp": clock.now(),
             "workers": {
-                "expected": self.threadiness,
+                "expected": self.total_workers,
+                "per_shard": self.threadiness,
                 "alive": alive,
                 "restarts": restarts,
                 "watchdog_alive": watchdog_alive,
             },
+            # Aggregate queue keys keep their pre-sharding shape; the
+            # per-shard breakdown (depth/backoff/latency quantiles per
+            # shard) rides along under queue.shards.
             "queue": dict(self.work_queue.stats(),
-                          quarantined=quarantine["count"]),
+                          quarantined=quarantine["count"],
+                          num_shards=self.num_shards),
+            "informer": (self.informer.report()
+                         if self.informer is not None else None),
             "syncs": {
                 "in_flight_stuck": stuck,
                 "stuck_sync_deadline_seconds": self.healing.stuck_sync_deadline,

@@ -1,0 +1,440 @@
+"""Shared informer cache: the controller's local, watch-fed read path.
+
+The reference controller reads through client-go SharedInformers (SURVEY.md
+§1 L0/L1): every GET/LIST the reconcile loop issues is served from an
+in-process store that watch streams keep fresh, so steady-state reconciles
+cost the apiserver nothing.  Until this module, our controller paid real
+wire traffic per sync — one GET in `controller._sync_job` plus two
+label-selected LISTs in `reconciler.get_pods_for_job`/`get_services_for_job`
+— which is exactly the per-job cost that caps a fleet at O(100) concurrent
+TPUJobs ("Exploring the limits of Concurrency in ML Training on Google
+TPUs", PAPERS.md).  `InformerCache` is the client-go analogue for
+ClusterInterface substrates:
+
+  - one `_Store` per resource kind (jobs, pods, services): objects keyed by
+    "ns/name" with two indexes — by namespace, and by the job-name owner
+    label (`gen_labels`' LABEL_JOB_NAME) that every reconcile LIST selects on
+    — so the hot list path is an index lookup, not a scan;
+  - watch-fed: the cache registers its handlers BEFORE the controller's, so
+    by the time a watch event enqueues a key the store already reflects it
+    (both substrates dispatch each event to handlers in registration order);
+  - a relist loop (`tpujob-informer-relist`) that re-LISTs every kind each
+    `relist_period` seconds and repairs the store with full diff semantics
+    (upserts + removal of gone objects).  This is the backstop for the one
+    failure watch supervision can't see: events lost while the stream stayed
+    "alive" (PR 5's `kick_stale_watches` heartbeat machinery handles dead
+    streams; the controller's watchdog calls `relist_soon()` after every
+    kick so repair happens immediately, not at the next period);
+  - read API mirroring the ClusterInterface read verbs (`get_job`,
+    `list_jobs`, `list_pods`, `list_services`): list reads always come from
+    the store; a `get_job` miss falls back to the wire (cold cache, or a
+    genuinely deleted job whose NotFound the controller needs) and is
+    counted on `tpujob_informer_cache_misses_total`.
+
+Writes never touch this module — create/delete/status stay on the wire path,
+and their watch echoes are what keep the store honest.  Staleness semantics
+and how the expectations cache makes stale reads safe are documented in
+docs/informer-cache.md.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..api import constants
+from ..utils import locks
+from ..utils import logging as tpulog
+from ..utils import metrics
+from .cluster import ClusterInterface, EventType
+
+log = tpulog.logger_for_key("informer")
+
+# Default period of the repair relist.  Deliberately long: watches carry the
+# steady state, and kick_stale_watches + relist_soon() cover the failure
+# case, so the period only bounds staleness nobody detected.
+DEFAULT_RELIST_PERIOD = 300.0
+
+# How long a deletion tombstone outlives its DELETED event.  A LIST snapshot
+# older than this cannot still be being applied (every prime/relist is one
+# bounded request + an in-memory walk), so pruning at this horizon keeps the
+# tombstone map O(recent deletions) without reopening the resurrect race.
+TOMBSTONE_TTL = 120.0
+
+
+def _matches(labels: Dict[str, str], selector: Optional[Dict[str, str]]) -> bool:
+    if not selector:
+        return True
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class _Store:
+    """One resource kind's indexed object store.
+
+    Objects are stored by "ns/name" key; `_by_namespace` and `_by_owner`
+    (namespace, job-name label) hold key sets for the two lookups the
+    controller actually does.  All three maps move together under one leaf
+    lock; no method calls out while holding it."""
+
+    def __init__(self, kind: str) -> None:
+        self.kind = kind
+        self._lock = locks.new_lock(f"informer-{kind}")
+        self._objects: Dict[str, Any] = {}  # guarded-by: _lock
+        self._by_namespace: Dict[str, Set[str]] = {}  # guarded-by: _lock
+        # (namespace, job-name label) -> keys; only objects carrying the
+        # label are indexed (jobs themselves aren't)
+        self._by_owner: Dict[Tuple[str, str], Set[str]] = {}  # guarded-by: _lock
+        # key -> monotonic deletion time.  A LIST snapshot is taken at some
+        # instant; a DELETED watch event processed after that instant but
+        # before the snapshot is merged must win, or the merge resurrects
+        # the object (a ghost the controller would then reconcile forever).
+        # merge()/replace_all() carry the snapshot time and skip any key
+        # whose tombstone is newer; a watch upsert (a genuine recreate,
+        # stream-ordered after the DELETED) clears the tombstone.
+        self._tombstones: Dict[str, float] = {}  # guarded-by: _lock
+        # key -> monotonic time of the last WATCH write.  The symmetric
+        # guard: an object created/modified by a watch event after the
+        # snapshot instant must not be evicted or reverted by applying
+        # that older snapshot (eviction would un-observe a creation the
+        # expectations cache already counted -> duplicate pod creates;
+        # reversion would roll a terminal pod back to Running with no
+        # further event to fix it).  One entry per live key, dropped with
+        # the key.
+        self._fresh: Dict[str, float] = {}  # guarded-by: _lock
+
+    @staticmethod
+    def _key(obj: Any) -> str:
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+    @staticmethod
+    def _owner(obj: Any) -> Optional[Tuple[str, str]]:
+        job_name = obj.metadata.labels.get(constants.LABEL_JOB_NAME)
+        if not job_name:
+            return None
+        return (obj.metadata.namespace, job_name)
+
+    # -- mutation (watch events + relist repair) --
+
+    def upsert(self, obj: Any) -> None:
+        """Watch-event write: the stream's ordering is authoritative, so an
+        ADDED/MODIFIED after a DELETED is a genuine recreate and clears the
+        tombstone; the freshness stamp protects this write from any older
+        LIST snapshot still being applied."""
+        key = self._key(obj)
+        now = time.monotonic()
+        with self._lock:
+            self._tombstones.pop(key, None)
+            self._fresh[key] = now
+            self._unindex_locked(key)
+            self._objects[key] = obj
+            self._index_locked(key, obj)
+
+    def remove(self, obj: Any) -> None:
+        key = self._key(obj)
+        now = time.monotonic()
+        with self._lock:
+            self._unindex_locked(key)
+            self._objects.pop(key, None)
+            self._fresh.pop(key, None)
+            self._tombstones[key] = now
+            if len(self._tombstones) > 64:  # amortized prune
+                horizon = now - TOMBSTONE_TTL
+                for old_key in [k for k, t in self._tombstones.items()
+                                if t < horizon]:
+                    del self._tombstones[old_key]
+
+    # requires-lock: _lock
+    def _snapshot_wins_locked(self, key: str, as_of: float) -> bool:
+        """May a LIST snapshot taken at `as_of` write `key`?  No when a
+        watch event — deletion (tombstone) or creation/update (freshness
+        stamp) — touched the key after the snapshot: the stream is more
+        current than the snapshot by construction."""
+        return (self._tombstones.get(key, -1.0) < as_of
+                and self._fresh.get(key, -1.0) < as_of)
+
+    def merge(self, objs: List[Any], as_of: float) -> None:
+        """Prime-path write: upsert `objs` from a LIST snapshot taken at
+        monotonic time `as_of`, never deleting — and never resurrecting,
+        reverting, or evicting anything a watch event touched after the
+        snapshot."""
+        with self._lock:
+            for obj in objs:
+                key = self._key(obj)
+                if not self._snapshot_wins_locked(key, as_of):
+                    continue
+                self._unindex_locked(key)
+                self._objects[key] = obj
+                self._index_locked(key, obj)
+
+    def replace_all(self, objs: List[Any], as_of: float) -> None:
+        """Relist repair: make the store exactly the `as_of` LIST snapshot —
+        upsert everything listed, drop everything that vanished — except
+        where a watch event outran the snapshot (see
+        _snapshot_wins_locked): an object created after the snapshot
+        survives, one modified after it keeps the newer state, one deleted
+        after it stays gone."""
+        fresh = {self._key(obj): obj for obj in objs}
+        now = time.monotonic()
+        with self._lock:
+            gone = [k for k in self._objects
+                    if k not in fresh and self._fresh.get(k, -1.0) < as_of]
+            for key in gone:
+                self._unindex_locked(key)
+                del self._objects[key]
+                self._fresh.pop(key, None)
+            for key, obj in fresh.items():
+                if not self._snapshot_wins_locked(key, as_of):
+                    continue
+                self._unindex_locked(key)
+                self._objects[key] = obj
+                self._index_locked(key, obj)
+            # the snapshot is the full truth as of `as_of`: tombstones at
+            # or before it have served their purpose
+            for key in [k for k, t in self._tombstones.items()
+                        if t < as_of or t < now - TOMBSTONE_TTL]:
+                del self._tombstones[key]
+
+    # requires-lock: _lock
+    def _index_locked(self, key: str, obj: Any) -> None:
+        self._by_namespace.setdefault(obj.metadata.namespace, set()).add(key)
+        owner = self._owner(obj)
+        if owner is not None:
+            self._by_owner.setdefault(owner, set()).add(key)
+
+    # requires-lock: _lock
+    def _unindex_locked(self, key: str) -> None:
+        old = self._objects.get(key)
+        if old is None:
+            return
+        bucket = self._by_namespace.get(old.metadata.namespace)
+        if bucket is not None:
+            bucket.discard(key)
+            if not bucket:
+                del self._by_namespace[old.metadata.namespace]
+        owner = self._owner(old)
+        if owner is not None:
+            obucket = self._by_owner.get(owner)
+            if obucket is not None:
+                obucket.discard(key)
+                if not obucket:
+                    del self._by_owner[owner]
+
+    # -- reads --
+
+    def get(self, namespace: str, name: str) -> Optional[Any]:
+        with self._lock:
+            return self._objects.get(f"{namespace}/{name}")
+
+    def list(self, namespace: Optional[str] = None,
+             selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        with self._lock:
+            job_name = (selector or {}).get(constants.LABEL_JOB_NAME)
+            if job_name and namespace:
+                keys = set(self._by_owner.get((namespace, job_name), ()))
+            elif namespace:
+                keys = set(self._by_namespace.get(namespace, ()))
+            else:
+                keys = set(self._objects)
+            out = [self._objects[k] for k in keys if k in self._objects]
+        # Verify the full selector outside the lock: the owner index narrows
+        # to one job's objects; remaining selector keys still filter.
+        return [o for o in out if _matches(o.metadata.labels, selector)]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+
+class InformerCache:
+    """Watch-fed read path over a ClusterInterface (see module docstring).
+
+    Construct BEFORE registering any other watch handler on `cluster`, so
+    this cache's handlers run first on every event; then use `get_job`/
+    `list_jobs`/`list_pods`/`list_services` wherever the controller used to
+    hit the wire.  `start_relist()` spawns the periodic repair thread (call
+    it from the controller's start(); constructing alone never spawns
+    threads so never-started controllers stay thread-free)."""
+
+    def __init__(self, cluster: ClusterInterface,
+                 relist_period: float = DEFAULT_RELIST_PERIOD) -> None:
+        self.cluster = cluster
+        self.relist_period = relist_period
+        self.jobs = _Store("jobs")
+        self.pods = _Store("pods")
+        self.services = _Store("services")
+        self._stop = threading.Event()
+        self._relist_now = threading.Event()
+        self._relist_thread: Optional[threading.Thread] = None
+        self._counter_lock = locks.new_lock("informer-counters")
+        # per-instance counters (the process-global metrics aggregate across
+        # every controller a test process creates; health reports want ours)
+        self._hits = 0  # guarded-by: _counter_lock
+        self._misses = 0  # guarded-by: _counter_lock
+        self._relists = 0  # guarded-by: _counter_lock
+
+        cluster.watch_jobs(self._on_job)
+        cluster.watch_pods(self._on_pod)
+        cluster.watch_services(self._on_service)
+        self._prime()
+
+    # -- watch handlers --
+
+    def _on_job(self, etype: EventType, obj: Any) -> None:
+        self._apply(self.jobs, etype, obj)
+
+    def _on_pod(self, etype: EventType, obj: Any) -> None:
+        self._apply(self.pods, etype, obj)
+
+    def _on_service(self, etype: EventType, obj: Any) -> None:
+        self._apply(self.services, etype, obj)
+
+    @staticmethod
+    def _apply(store: _Store, etype: EventType, obj: Any) -> None:
+        if etype == EventType.DELETED:
+            store.remove(obj)
+        else:
+            store.upsert(obj)
+
+    # -- priming / relist --
+
+    def _kinds(self):
+        """(kind, store, list_fn) for every cached resource — the ONE
+        place to extend when a new kind joins the cache; _prime(), relist()
+        and their error handling all iterate this table."""
+        return (("jobs", self.jobs, self.cluster.list_jobs),
+                ("pods", self.pods, self.cluster.list_pods),
+                ("services", self.services, self.cluster.list_services))
+
+    @staticmethod
+    def _fill(store: _Store, list_fn, replace: bool) -> None:
+        """One kind's snapshot application.  `as_of` is captured BEFORE
+        the LIST so any watch event processed after this instant wins over
+        the (by then older) snapshot."""
+        as_of = time.monotonic()
+        objs = list_fn()
+        if replace:
+            store.replace_all(objs, as_of)
+        else:
+            store.merge(objs, as_of)
+
+    def _prime(self) -> None:
+        """Initial fill.  Watches are registered first, so anything created
+        during the prime arrives as an event; the prime itself merges
+        (never deletes) and deletion tombstones stop it resurrecting an
+        object a concurrent DELETED event just removed.  Each LIST is
+        guarded independently — a faulted/flaky substrate at construction
+        time leaves that kind cold, and watches + the relist loop repair
+        it."""
+        for kind, store, list_fn in self._kinds():
+            try:
+                self._fill(store, list_fn, replace=False)
+            except Exception as err:  # noqa: BLE001 — cold start is legal
+                log.warning("informer prime of %s failed (%s); relying on "
+                            "watch replay / relist", kind, err)
+
+    def relist(self) -> None:
+        """One full repair pass over every kind, synchronously.  Guarded
+        per kind: a failing LIST leaves that store as-was (stale beats
+        empty) and the next pass retries."""
+        for kind, store, list_fn in self._kinds():
+            try:
+                self._fill(store, list_fn, replace=True)
+                metrics.informer_relists.labels(kind).inc()
+                with self._counter_lock:
+                    self._relists += 1
+            except Exception as err:  # noqa: BLE001 — repair must not die
+                log.warning("informer relist of %s failed: %s", kind, err)
+
+    def relist_soon(self) -> None:
+        """Wake the relist loop now (the watchdog calls this right after
+        kick_stale_watches force-reconnects a blind stream, so repair does
+        not wait out the period)."""
+        self._relist_now.set()
+
+    def start_relist(self) -> None:
+        """Spawn the repair thread (idempotent).  With relist_period <= 0
+        the thread still runs but only fires on relist_soon() — the
+        stale-watch repair path must work even when the periodic relist is
+        disabled, or a blind stream's lost deletions would never be
+        repaired."""
+        if self._relist_thread is not None and self._relist_thread.is_alive():
+            return
+        thread = threading.Thread(target=self._relist_loop,
+                                  name="tpujob-informer-relist", daemon=True)
+        self._relist_thread = thread
+        thread.start()
+
+    def _relist_loop(self) -> None:
+        period = self.relist_period if self.relist_period > 0 else None
+        while not self._stop.is_set():
+            self._relist_now.wait(timeout=period)
+            self._relist_now.clear()
+            if self._stop.is_set():
+                return
+            self.relist()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._relist_now.set()
+        thread = self._relist_thread
+        if thread is not None:
+            thread.join(timeout=5)
+
+    # -- counters --
+
+    def _count(self, resource: str, hit: bool) -> None:
+        with self._counter_lock:
+            if hit:
+                self._hits += 1
+            else:
+                self._misses += 1
+        (metrics.informer_cache_hits if hit
+         else metrics.informer_cache_misses).labels(resource).inc()
+
+    def counters(self) -> Dict[str, int]:
+        with self._counter_lock:
+            return {"hits": self._hits, "misses": self._misses,
+                    "relists": self._relists}
+
+    def report(self) -> dict:
+        """Store sizes + counters for the deep health report."""
+        out: Dict[str, Any] = {
+            "jobs": len(self.jobs),
+            "pods": len(self.pods),
+            "services": len(self.services),
+            "relist_period_seconds": self.relist_period,
+        }
+        out.update(self.counters())
+        return out
+
+    # -- the ClusterInterface read verbs, served locally --
+
+    def get_job(self, namespace: str, name: str) -> Any:
+        job = self.jobs.get(namespace, name)
+        if job is not None:
+            self._count("jobs", hit=True)
+            return job
+        # Miss: cold cache or a deleted job.  The wire GET disambiguates —
+        # its NotFound is exactly what the controller's cleanup path needs.
+        # The result is deliberately NOT written back into the store: a
+        # GET racing a DELETED watch event could resurrect a deleted job as
+        # a permanent cache hit (the NotFound cleanup path would then be
+        # unreachable).  The watch stream is the only steady-state writer;
+        # a cold key pays the wire until its ADDED arrives, which is the
+        # same moment the controller would learn about it anyway.
+        self._count("jobs", hit=False)
+        return self.cluster.get_job(namespace, name)
+
+    def list_jobs(self, namespace: Optional[str] = None) -> List[Any]:
+        self._count("jobs", hit=True)
+        return self.jobs.list(namespace)
+
+    def list_pods(self, namespace: Optional[str] = None,
+                  selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        self._count("pods", hit=True)
+        return self.pods.list(namespace, selector)
+
+    def list_services(self, namespace: Optional[str] = None,
+                      selector: Optional[Dict[str, str]] = None) -> List[Any]:
+        self._count("services", hit=True)
+        return self.services.list(namespace, selector)

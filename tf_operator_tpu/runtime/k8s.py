@@ -705,6 +705,11 @@ class KubeClient:
         self.health = ClientHealth()
         self._clock = clock
         self._sleep = sleep
+        # Per-verb request attempts, for the informer's deterministic
+        # traffic-collapse assertions (tests and bench read these instead
+        # of timing anything).  Mirrored onto tpujob_api_requests_total.
+        self._count_lock = locks.new_lock("client-request-counts")
+        self.request_counts: Dict[str, int] = {}  # guarded-by: _count_lock
         self.limiter = TokenBucket(qps, burst, clock=clock, sleep=sleep)
         parts = urlsplit(config.host)
         self._scheme = parts.scheme or "https"
@@ -820,6 +825,7 @@ class KubeClient:
         so TransportError.before_send is accurate — the distinction that
         makes write retries safe."""
         self._throttle()
+        self._count_request(method)
         if self.faults is not None:
             fault = self.faults.for_request(method, path)
             if fault is not None:
@@ -848,6 +854,20 @@ class KubeClient:
         finally:
             conn.close()
 
+    def _count_request(self, verb: str) -> None:
+        with self._count_lock:
+            self.request_counts[verb] = self.request_counts.get(verb, 0) + 1
+        metrics.api_requests.labels(verb).inc()
+
+    def request_count(self, *verbs: str) -> int:
+        """Total request attempts issued, optionally restricted to `verbs`
+        (e.g. request_count("GET") = reads the informer should have
+        collapsed).  Watch streams are counted under "WATCH"."""
+        with self._count_lock:
+            if not verbs:
+                return sum(self.request_counts.values())
+            return sum(self.request_counts.get(v, 0) for v in verbs)
+
     def _apply_fault(self, fault: Any, method: str, path: str) -> None:
         """Translate an injected fault into the exact failure shape the real
         transport produces, so the retry policy can't tell them apart."""
@@ -875,6 +895,7 @@ class KubeClient:
         # Establishing a watch costs one token (client-go throttles watch
         # creation the same way); the long-lived stream itself is free.
         self._throttle()
+        self._count_request("WATCH")
         events_left: Optional[int] = None
         if self.faults is not None:
             fault = self.faults.for_watch(path)

@@ -20,7 +20,7 @@ of the 15-method ControllerInterface (vendor/.../apis/common/v1/interface.go:10-
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 from ..api import constants
 from ..api.core import (
@@ -46,7 +46,7 @@ from ..utils import clock
 from ..utils import logging as tpulog
 from ..utils import metrics
 from . import conditions
-from .cluster import ClusterInterface, NotFound
+from .cluster import AlreadyExists, ClusterInterface, NotFound
 from .control import PodControlInterface, ServiceControlInterface
 from .expectations import Expectations, expectation_key
 
@@ -228,6 +228,7 @@ class JobReconciler:
         service_control: ServiceControlInterface,
         plugin: JobPlugin,
         config: Optional[ReconcilerConfig] = None,
+        reads: Optional[Any] = None,
     ) -> None:
         self.cluster = cluster
         self.pod_control = pod_control
@@ -235,31 +236,39 @@ class JobReconciler:
         self.plugin = plugin
         self.config = config or ReconcilerConfig()
         self.expectations = Expectations()
+        # The read path: an informer cache (runtime/informer.py) when the
+        # controller runs one, else the cluster itself.  Only the list verbs
+        # the per-sync hot path issues go through it; every write — and the
+        # gang/PDB bookkeeping — stays on the wire.  Stale reads are safe
+        # because the expectations cache gates syncs until this view has
+        # observed our own creations/deletions (ref: controller.go:319).
+        self.reads = reads if reads is not None else cluster
 
     # ------------------------------------------------------------------
     # object ownership
 
     def get_pods_for_job(self, job: TPUJob) -> List[Pod]:
         """Label-selected pods, claimed by owner UID; orphans with matching
-        labels are adopted (ref: GetPodsForJob + ControllerRefManager,
-        common/pod.go:219-254)."""
+        labels are claimed (ref: GetPodsForJob + ControllerRefManager,
+        common/pod.go:219-254).  Claiming is a per-pass decision, NOT an
+        in-place adoption write: the listed objects are shared informer/
+        store state, and stamping a job uid onto them would persist a
+        controller-local fiction the apiserver never saw — a later job
+        recreated under the same name (new uid) would then find the cached
+        pods "owned" by the dead uid and refuse to claim them.  The
+        reference adopts by PATCHing ownerReferences server-side; until we
+        do that, an orphan is simply claimed again each pass."""
         selector = gen_labels(job.metadata.name)
-        pods = self.cluster.list_pods(namespace=job.metadata.namespace, selector=selector)
-        claimed = []
-        for pod in pods:
-            if not pod.metadata.owner_uid:
-                # adopt
-                pod.metadata.owner_kind = job.kind
-                pod.metadata.owner_name = job.metadata.name
-                pod.metadata.owner_uid = job.metadata.uid
-                claimed.append(pod)
-            elif pod.metadata.controlled_by(job.kind, job.metadata.uid):
-                claimed.append(pod)
-        return claimed
+        pods = self.reads.list_pods(namespace=job.metadata.namespace, selector=selector)
+        return [
+            pod for pod in pods
+            if not pod.metadata.owner_uid
+            or pod.metadata.controlled_by(job.kind, job.metadata.uid)
+        ]
 
     def get_services_for_job(self, job: TPUJob) -> List[Service]:
         selector = gen_labels(job.metadata.name)
-        services = self.cluster.list_services(
+        services = self.reads.list_services(
             namespace=job.metadata.namespace, selector=selector
         )
         return [
@@ -360,9 +369,20 @@ class JobReconciler:
             job, replicas, job.status, pods, restarting_this_pass
         )
         self._write_status_if_changed(job, old_status)
-        # ActiveDeadlineSeconds enforcement is scheduled once when start_time
-        # is first set (plugin hook → workqueue.add_after, ref: status.go:78-86)
-        # and backstopped by the controller's periodic resync loop.
+        # ActiveDeadlineSeconds enforcement: re-arm the wakeup on EVERY
+        # pass, not only when start_time is first set (the plugin hook,
+        # ref: status.go:78-86).  The workqueue coalesces delayed
+        # deliveries to the earliest pending deadline per key, so a
+        # one-shot far-future arm can be displaced by a sooner retry; with
+        # every pass re-arming, whichever delivery runs first restores the
+        # deadline wakeup.  The periodic resync loop remains the restart
+        # backstop.
+        deadline = job.spec.run_policy.active_deadline_seconds
+        if deadline is not None and job.status.start_time is not None:
+            remaining = deadline - (clock.now() - job.status.start_time)
+            if remaining > 0 and (result.requeue_after is None
+                                  or remaining < result.requeue_after):
+                result.requeue_after = remaining
         log.debug("reconcile complete")
         return result
 
@@ -553,6 +573,15 @@ class JobReconciler:
 
         try:
             self.pod_control.create_pod(pod, job)
+        except AlreadyExists:
+            # Benign: the pod exists server-side but this sync's view was
+            # stale — possible since reads come from the informer cache and
+            # enable_dynamic_worker bypasses the expectations gate.  The
+            # watch event will land and the next sync sees the pod; failing
+            # the sync here would turn the race into a backoff/quarantine
+            # spiral on a healthy job.
+            self.expectations.creation_observed(expectation_key(job_key, rtype.value, "pods"))
+            return
         except Exception:
             self.expectations.creation_observed(expectation_key(job_key, rtype.value, "pods"))
             raise
@@ -612,6 +641,12 @@ class JobReconciler:
         )
         try:
             self.service_control.create_service(svc, job)
+        except AlreadyExists:
+            # Same stale-view race as create_new_pod: existing == created.
+            self.expectations.creation_observed(
+                expectation_key(job.key(), rtype.value, "services")
+            )
+            return
         except Exception:
             self.expectations.creation_observed(
                 expectation_key(job.key(), rtype.value, "services")

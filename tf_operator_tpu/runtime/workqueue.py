@@ -1,4 +1,4 @@
-"""Rate-limited, deduplicating work queue.
+"""Rate-limited, deduplicating work queue — and its sharded composition.
 
 Behavioral contract of client-go's workqueue as the reference uses it
 (/root/reference/vendor/github.com/kubeflow/common/pkg/controller.v1/common/job_controller.go:129-135):
@@ -12,25 +12,75 @@ Behavioral contract of client-go's workqueue as the reference uses it
   - add_after(key, delay) schedules a future enqueue (used to re-arm
     ActiveDeadlineSeconds, ref: pkg/controller.v1/tensorflow/job.go:153-168)
   - forget(key) resets the key's backoff
+
+Two scale additions over the original single queue (ROADMAP item 1,
+docs/informer-cache.md):
+
+  - **Coalesced delayed delivery.**  add_after used to spawn one
+    threading.Timer per call; a resync/probation burst at 5k jobs would
+    leak thousands of timer threads.  Now each queue keeps one
+    earliest-deadline-per-key map served by a single `tpujob-requeue-*`
+    dispatcher thread: re-arming a key keeps the soonest pending deadline
+    and later ones are absorbed.
+  - **ShardedWorkQueue.**  N independent RateLimitingQueues selected by a
+    stable key hash (crc32 — process-independent, unlike hash()), each with
+    its own worker pool, so a hot tenant's backoff storm cannot serialize
+    other tenants behind it.  With shards=1 it routes every call to one
+    RateLimitingQueue and preserves the single-queue behavior exactly.
+
+Every queue also records enqueue→dequeue age per delivery (bounded rolling
+window) and serves p50/p95/p99 through stats() — the raw material for
+`tpujob_queue_latency_seconds` and the /healthz queue section.
 """
 from __future__ import annotations
 
+import heapq
 import threading
 import time
+import zlib
 from collections import deque
-from typing import Dict, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..utils import locks
+
+# Rolling window of per-delivery queue latencies kept per queue: big enough
+# for stable p99 under load, small enough to be O(ms) to snapshot.
+LATENCY_WINDOW = 1024
+
+LATENCY_QUANTILES = (("p50", 0.50), ("p95", 0.95), ("p99", 0.99))
 
 
 class ShutDown(Exception):
     pass
 
 
+def shard_for(key: str, num_shards: int) -> int:
+    """Stable shard index for `key`: crc32, NOT hash() — Python string
+    hashing is salted per process, and a key must land on the same shard
+    across restarts for backoff/latency accounting to mean anything."""
+    if num_shards <= 1:
+        return 0
+    return zlib.crc32(key.encode("utf-8")) % num_shards
+
+
+def _percentiles(sample: List[float]) -> Dict[str, float]:
+    """Nearest-rank percentiles of `sample` (unsorted ok; empty -> zeros)."""
+    if not sample:
+        return {name: 0.0 for name, _q in LATENCY_QUANTILES}
+    ordered = sorted(sample)
+    out = {}
+    for name, q in LATENCY_QUANTILES:
+        rank = min(len(ordered) - 1, max(0, int(q * len(ordered) + 0.5) - 1))
+        out[name] = ordered[rank]
+    return out
+
+
 class RateLimitingQueue:
     def __init__(
-        self, base_delay: float = 0.005, max_delay: float = 1000.0
+        self, base_delay: float = 0.005, max_delay: float = 1000.0,
+        name: str = "workqueue",
     ) -> None:
+        self.name = name
         self._cond = locks.new_condition("workqueue")
         self._queue: deque[str] = deque()  # guarded-by: _cond
         self._dirty: Set[str] = set()  # guarded-by: _cond
@@ -39,7 +89,20 @@ class RateLimitingQueue:
         self._base_delay = base_delay
         self._max_delay = max_delay
         self._shutting_down = False  # guarded-by: _cond
-        self._timers: Set[threading.Timer] = set()  # guarded-by: _cond
+        # Coalesced delayed delivery: key -> earliest pending monotonic
+        # deadline, plus a lazy-deletion heap the dispatcher thread drains.
+        # Re-arming a key keeps only the soonest deadline, so resync and
+        # probation bursts cost one map entry, not one timer thread each.
+        self._pending: Dict[str, float] = {}  # guarded-by: _cond
+        self._deadlines: List[Tuple[float, str]] = []  # guarded-by: _cond
+        self._dispatcher: Optional[threading.Thread] = None  # guarded-by: _cond
+        # The dispatcher parks on this Event (NOT on _cond — it must never
+        # steal a notify() aimed at a get() waiter).
+        self._timer_wake = threading.Event()
+        # enqueue timestamp per deliverable key + rolling latency window
+        self._enqueued_at: Dict[str, float] = {}  # guarded-by: _cond
+        self._latencies: deque = deque(maxlen=LATENCY_WINDOW)  # guarded-by: _cond
+        self._delivered = 0  # guarded-by: _cond
 
     # --- core queue semantics ---
 
@@ -50,6 +113,7 @@ class RateLimitingQueue:
             self._dirty.add(key)
             if key not in self._processing:
                 self._queue.append(key)
+                self._enqueued_at.setdefault(key, time.monotonic())
                 self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> str:
@@ -66,6 +130,10 @@ class RateLimitingQueue:
             key = self._queue.popleft()
             self._processing.add(key)
             self._dirty.discard(key)
+            enqueued = self._enqueued_at.pop(key, None)
+            if enqueued is not None:
+                self._latencies.append(time.monotonic() - enqueued)
+            self._delivered += 1
             return key
 
     def done(self, key: str) -> None:
@@ -73,6 +141,7 @@ class RateLimitingQueue:
             self._processing.discard(key)
             if key in self._dirty:
                 self._queue.append(key)
+                self._enqueued_at.setdefault(key, time.monotonic())
                 self._cond.notify()
 
     # --- rate limiting ---
@@ -96,45 +165,171 @@ class RateLimitingQueue:
         if delay <= 0:
             self.add(key)
             return
-        timer: threading.Timer = threading.Timer(delay, lambda: self._timer_fire(key, timer))
-        timer.name = f"tpujob-requeue-{key}"
-        timer.daemon = True
+        deadline = time.monotonic() + delay
         with self._cond:
             if self._shutting_down:
                 return
-            self._timers.add(timer)
-        timer.start()
+            current = self._pending.get(key)
+            if current is not None and current <= deadline:
+                return  # an earlier delivery is already pending: coalesce
+            self._pending[key] = deadline
+            heapq.heappush(self._deadlines, (deadline, key))
+            if self._dispatcher is None or not self._dispatcher.is_alive():
+                dispatcher = threading.Thread(
+                    target=self._requeue_loop,
+                    name=f"tpujob-requeue-{self.name}", daemon=True)
+                self._dispatcher = dispatcher
+                dispatcher.start()
+        self._timer_wake.set()
 
-    def _timer_fire(self, key: str, timer: threading.Timer) -> None:
-        with self._cond:
-            self._timers.discard(timer)
-        self.add(key)
+    def _requeue_loop(self) -> None:
+        """The one delayed-delivery thread per queue: sleeps until the
+        soonest pending deadline, delivers every due key, repeats.  Heap
+        entries superseded by an earlier re-arm are skipped lazily (the
+        _pending map holds the authoritative deadline per key)."""
+        while True:
+            self._timer_wake.clear()
+            due: List[str] = []
+            with self._cond:
+                if self._shutting_down:
+                    return
+                now = time.monotonic()
+                while self._deadlines and self._deadlines[0][0] <= now:
+                    deadline, key = heapq.heappop(self._deadlines)
+                    if self._pending.get(key) == deadline:
+                        del self._pending[key]
+                        due.append(key)
+                timeout = (self._deadlines[0][0] - now
+                           if self._deadlines else None)
+            for key in due:
+                self.add(key)
+            self._timer_wake.wait(timeout=timeout)
 
     # --- observability ---
 
-    def stats(self) -> Dict[str, int]:
+    def stats(self, include_sample: bool = False) -> Dict[str, object]:
         """One consistent snapshot for the health report / watchdog gauges:
         depth (keys deliverable now), dirty (pending incl. redeliveries),
-        processing (keys a worker holds), and backoff_tracked (keys with
-        rate-limiter state — the set forget() clears)."""
+        processing (keys a worker holds), backoff_tracked (keys with
+        rate-limiter state — the set forget() clears), pending_timers
+        (coalesced delayed deliveries), delivered (keys handed to workers
+        over this queue's lifetime), and enqueue→dequeue latency
+        percentiles over the rolling window.  include_sample=True adds the
+        raw window under "_sample" (ShardedWorkQueue pools it for the
+        aggregate percentiles from the SAME snapshot, so the per-shard and
+        pooled numbers in one report cannot disagree)."""
         with self._cond:
-            return {
+            sample = list(self._latencies)
+            out: Dict[str, object] = {
                 "depth": len(self._queue),
                 "dirty": len(self._dirty),
                 "processing": len(self._processing),
                 "backoff_tracked": len(self._failures),
+                "pending_timers": len(self._pending),
+                "delivered": self._delivered,
+                "latency": _percentiles(sample),
             }
+        if include_sample:
+            out["_sample"] = sample
+        return out
 
     # --- lifecycle ---
 
     def shutdown(self) -> None:
         with self._cond:
             self._shutting_down = True
-            for t in self._timers:
-                t.cancel()
-            self._timers.clear()
+            self._pending.clear()
+            self._deadlines.clear()
             self._cond.notify_all()
+        self._timer_wake.set()  # release the dispatcher
 
     def __len__(self) -> int:
         with self._cond:
             return len(self._queue)
+
+
+class ShardedWorkQueue:
+    """N independent RateLimitingQueues addressed by stable key hash.
+
+    Keyed operations (add/add_after/add_rate_limited/forget/num_requeues/
+    done) route to `shard_for(key)`'s queue, so every per-key invariant of
+    the single queue — dedup, never-concurrent processing, redelivery,
+    backoff — holds unchanged within a shard, and a key always lands on the
+    same shard.  Workers attach to one shard each via `shard(i).get()`:
+    there is no cross-shard stealing, which is exactly the isolation
+    property (a poisoned tenant saturating shard A's backoff cannot add a
+    millisecond of queue latency to shard B).
+
+    With num_shards=1 every call forwards to the single underlying
+    RateLimitingQueue — today's behavior, preserved exactly.
+    """
+
+    def __init__(self, num_shards: int = 1, base_delay: float = 0.005,
+                 max_delay: float = 1000.0) -> None:
+        self.num_shards = max(1, int(num_shards))
+        self.shards: List[RateLimitingQueue] = [
+            RateLimitingQueue(base_delay=base_delay, max_delay=max_delay,
+                              name=f"shard-{i}")
+            for i in range(self.num_shards)
+        ]
+
+    # --- routing ---
+
+    def shard_index(self, key: str) -> int:
+        return shard_for(key, self.num_shards)
+
+    def shard(self, index: int) -> RateLimitingQueue:
+        return self.shards[index]
+
+    def shard_of(self, key: str) -> RateLimitingQueue:
+        return self.shards[self.shard_index(key)]
+
+    # --- keyed operations (single-queue API, routed) ---
+
+    def add(self, key: str) -> None:
+        self.shard_of(key).add(key)
+
+    def add_after(self, key: str, delay: float) -> None:
+        self.shard_of(key).add_after(key, delay)
+
+    def add_rate_limited(self, key: str) -> None:
+        self.shard_of(key).add_rate_limited(key)
+
+    def forget(self, key: str) -> None:
+        self.shard_of(key).forget(key)
+
+    def num_requeues(self, key: str) -> int:
+        return self.shard_of(key).num_requeues(key)
+
+    def done(self, key: str) -> None:
+        self.shard_of(key).done(key)
+
+    # --- observability ---
+
+    def stats(self) -> Dict[str, object]:
+        """Aggregate of the single-queue keys (so existing consumers keep
+        reading the same shape) plus a per-shard breakdown under "shards".
+        The aggregate latency percentiles pool every shard's window — the
+        fleet-wide view; per-tenant isolation shows up in the per-shard
+        numbers."""
+        per_shard = [q.stats(include_sample=True) for q in self.shards]
+        pooled: List[float] = []
+        for s in per_shard:
+            pooled.extend(s.pop("_sample"))
+        agg: Dict[str, object] = {
+            key: sum(s[key] for s in per_shard)
+            for key in ("depth", "dirty", "processing", "backoff_tracked",
+                        "pending_timers", "delivered")
+        }
+        agg["latency"] = _percentiles(pooled)
+        agg["shards"] = per_shard
+        return agg
+
+    # --- lifecycle ---
+
+    def shutdown(self) -> None:
+        for q in self.shards:
+            q.shutdown()
+
+    def __len__(self) -> int:
+        return sum(len(q) for q in self.shards)

@@ -109,6 +109,24 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--watch-stale-deadline", type=float, default=300.0,
                         help="seconds without any watch event/heartbeat "
                              "before a watch stream is force-reconnected")
+    # Control-plane scale knobs (docs/informer-cache.md; no reference
+    # analogue — client-go gives the reference informers for free, and it
+    # never shards its workqueue).
+    parser.add_argument("--reconcile-shards", type=int, default=1,
+                        help="independent reconcile shards (workqueue + "
+                             "worker pool each, keys assigned by stable "
+                             "hash); --threadiness is workers PER shard. "
+                             "1 preserves the single-queue behavior exactly")
+    parser.add_argument("--informer-relist-period", type=float, default=300.0,
+                        help="seconds between informer store repair relists "
+                             "(<=0 disables the periodic relist; watch "
+                             "streams and stale-watch kicks still keep the "
+                             "cache fresh)")
+    parser.add_argument("--no-informer", dest="use_informer",
+                        action="store_false", default=True,
+                        help="disable the shared informer cache: every sync "
+                             "reads the apiserver directly (pre-informer "
+                             "behavior; for debugging and A/B only)")
     return parser
 
 
@@ -317,6 +335,9 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
         config=config,
         threadiness=args.threadiness,
         healing=healing,
+        shards=args.reconcile_shards,
+        use_informer=args.use_informer,
+        informer_relist_period=args.informer_relist_period,
         **({"resolver": resolver_owner.resolver} if resolver_owner else {}),
     )
     if getattr(args, "slice_inventory", None) and not gang_in_process:

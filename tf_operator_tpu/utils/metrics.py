@@ -217,3 +217,45 @@ watch_stale_total = REGISTRY.counter(
     "Watch streams force-reconnected after going heartbeat-stale",
     ("watch",),
 )
+# Informer cache (runtime/informer.py, docs/informer-cache.md): the watch-fed
+# local store the controller and reconciler read instead of per-sync apiserver
+# GET/LIST traffic.  A healthy informer shows a hit rate near 1.0; misses are
+# wire fallbacks (cold cache or a just-deleted object), relists are the
+# periodic store repairs that bound staleness after dropped watches.
+informer_cache_hits = REGISTRY.counter(
+    "tpujob_informer_cache_hits_total",
+    "Controller reads served from the informer's local store",
+    ("resource",),
+)
+informer_cache_misses = REGISTRY.counter(
+    "tpujob_informer_cache_misses_total",
+    "Controller reads that fell back to the apiserver (cold or deleted)",
+    ("resource",),
+)
+informer_relists = REGISTRY.counter(
+    "tpujob_informer_relists_total",
+    "Periodic/triggered full relists that repaired the informer store",
+    ("resource",),
+)
+# Sharded reconcile core (runtime/workqueue.py ShardedWorkQueue): per-shard
+# queue pressure and enqueue->dequeue latency quantiles, sampled by the
+# watchdog.  tpujob_queue_depth stays the fleet aggregate.
+queue_shard_depth = REGISTRY.gauge(
+    "tpujob_queue_shard_depth",
+    "Keys waiting in one reconcile shard's work queue",
+    ("shard",),
+)
+queue_latency = REGISTRY.gauge(
+    "tpujob_queue_latency_seconds",
+    "Enqueue-to-dequeue latency quantiles per reconcile shard "
+    "(rolling window, watchdog-sampled)",
+    ("shard", "quantile"),
+)
+# Client-side apiserver request accounting (runtime/k8s.py KubeClient): every
+# completed request attempt by verb.  The informer acceptance gate ("per-sync
+# GET/LIST traffic collapses") is asserted against these, not wall-clock.
+api_requests = REGISTRY.counter(
+    "tpujob_api_requests_total",
+    "Apiserver requests issued by this process's client, by verb",
+    ("verb",),
+)
