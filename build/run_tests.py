@@ -78,22 +78,44 @@ def run_pytest(args_list: list[str], junit_path: str) -> int:
 
 
 def run_lint_tier(junit_dir: str, paths: list[str]) -> int:
-    """One checker pass, no retries: `--tier lint`.  `paths` (relative to
-    --root) default to the repo's own package."""
-    targets = [p if os.path.isabs(p) else os.path.join(ROOT, p)
-               for p in paths] or [os.path.join(REPO, "tf_operator_tpu")]
+    """One checker pass per target, no retries: `--tier lint`.  `paths`
+    (relative to --root) default to the repo's own package (all rules,
+    interprocedural included) plus the tests tree (test-hygiene rules only:
+    sleep-poll, with the known-bad lint fixtures excluded).  Each pass also
+    writes its machine-readable findings (`--json`) next to
+    lint-summary.json so CI uploads them as one artifact set."""
+    if paths:
+        targets = [(p if os.path.isabs(p) else os.path.join(ROOT, p), [])
+                   for p in paths]
+    else:
+        targets = [
+            (os.path.join(REPO, "tf_operator_tpu"), []),
+            (os.path.join(REPO, "tests"),
+             ["--rules", "sleep-poll", "--exclude", "lint_fixtures"]),
+        ]
     env = dict(os.environ)
     # the checker lives in this repo's package, wherever --root points
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     rc = 0
-    for target in targets:
-        cmd = [sys.executable, "-m", "tf_operator_tpu.analysis", target]
+    findings_json: list[str] = []
+    used_names: set[str] = set()
+    for index, (target, extra) in enumerate(targets):
+        name = ("lint-findings.json" if index == 0
+                else f"lint-findings-{os.path.basename(target)}.json")
+        if name in used_names:  # duplicate basenames must not overwrite
+            name = name[:-len(".json")] + f"-{index + 1}.json"
+        used_names.add(name)
+        json_path = os.path.join(junit_dir, name)
+        findings_json.append(json_path)
+        cmd = [sys.executable, "-m", "tf_operator_tpu.analysis", target,
+               "--json", json_path, *extra]
         print("+", " ".join(cmd), flush=True)
         rc |= subprocess.call(cmd, cwd=ROOT, env=env)
     status = "pass" if rc == 0 else "fail"
     with open(os.path.join(junit_dir, "lint-summary.json"), "w") as f:
         json.dump({"tier": "lint", "attempts": 1, "status": status,
-                   "targets": targets}, f, indent=2)
+                   "targets": [t for t, _extra in targets],
+                   "findings_json": findings_json}, f, indent=2)
     print(f"RESULT tier=lint attempts=1 status={status}", flush=True)
     return 0 if rc == 0 else 1
 

@@ -1,0 +1,32 @@
+"""Known-bad fixture: rule `atomicity` must fire exactly once (line 19,
+the write): `put_once` checks membership under one acquisition and writes
+under a second — another thread can slip between the two acquisitions.
+`put_once_safely` (one critical section) and `put_checked` (re-validated
+double-check) are both clean."""
+from tf_operator_tpu.utils import locks
+
+
+class Cache:
+    def __init__(self):
+        self._lock = locks.new_lock("cache")
+        self._slots = {}  # guarded-by: _lock
+
+    def put_once(self, key, value):
+        with self._lock:
+            present = key in self._slots
+        if not present:
+            with self._lock:
+                self._slots[key] = value
+
+    def put_once_safely(self, key, value):
+        with self._lock:
+            if key not in self._slots:
+                self._slots[key] = value
+
+    def put_checked(self, key, value):
+        with self._lock:
+            present = key in self._slots
+        if not present:
+            with self._lock:
+                if key not in self._slots:
+                    self._slots[key] = value

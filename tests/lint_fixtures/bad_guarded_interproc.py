@@ -1,0 +1,28 @@
+"""Known-bad fixture: rule `guarded-by-interproc` must fire exactly once
+(line 17): `_collect` reads the guarded `_items` lock-free and is reachable
+through the public `snapshot` with no caller holding the lock.  The
+intraprocedural `guarded-by` rule cannot see this — it only checks writes."""
+from tf_operator_tpu.utils import locks
+
+
+class Box:
+    def __init__(self):
+        self._lock = locks.new_lock("box")
+        self._items = []  # guarded-by: _lock
+
+    def snapshot(self):
+        return self._collect()
+
+    def _collect(self):
+        return list(self._items)
+
+    def add(self, value):
+        with self._lock:
+            self._items.append(value)
+
+    def snapshot_safely(self):
+        with self._lock:
+            return self._collect_locked()
+
+    def _collect_locked(self):  # requires-lock: _lock
+        return list(self._items)

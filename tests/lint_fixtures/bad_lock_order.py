@@ -1,0 +1,23 @@
+"""Known-bad fixture: rule `lock-order` must fire exactly once: transfer()
+nests ledger-a -> ledger-b while audit() nests ledger-b -> ledger-a — the
+classic two-lock deadlock precondition."""
+from tf_operator_tpu.utils import locks
+
+
+class Ledger:
+    def __init__(self):
+        self._alock = locks.new_lock("ledger-a")
+        self._block = locks.new_lock("ledger-b")
+        self.a = 0
+        self.b = 0
+
+    def transfer(self):
+        with self._alock:
+            with self._block:
+                self.a -= 1
+                self.b += 1
+
+    def audit(self):
+        with self._block:
+            with self._alock:
+                return self.a + self.b

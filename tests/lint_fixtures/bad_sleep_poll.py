@@ -1,0 +1,18 @@
+"""Known-bad fixture: rule `sleep-poll` must fire exactly once (line 9):
+an unbounded predicate poll that hangs forever instead of timing out.
+Checked with rel_path "tests/bad_sleep_poll.py" to land in tests scope."""
+import time
+
+
+def wait_forever(predicate):
+    while not predicate():
+        time.sleep(0.05)
+
+
+def wait_bounded(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while not predicate():
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(0.05)
+    return True

@@ -66,8 +66,10 @@ def test_hard_failure_exhausts_retries(tmp_path):
 
 
 def test_lint_tier_passes_on_clean_repo_package(tmp_path):
-    """`--tier lint` on the repo's own package: zero findings, pass line,
-    summary JSON — and no pytest/junit machinery involved."""
+    """`--tier lint` with no paths: the package (all rules) AND the tests
+    tree (sleep-poll, fixtures excluded) — zero findings, pass line,
+    summary JSON, machine-readable findings uploaded next to it, and no
+    pytest/junit machinery involved."""
     proc = subprocess.run(
         [sys.executable, str(RUNNER), "--tier", "lint",
          "--root", str(tmp_path), "--junit-dir", "junit"],
@@ -78,8 +80,17 @@ def test_lint_tier_passes_on_clean_repo_package(tmp_path):
     assert "0 finding(s)" in proc.stdout
     summary = json.loads(
         (tmp_path / "junit" / "lint-summary.json").read_text())
-    assert summary == {"tier": "lint", "attempts": 1, "status": "pass",
-                       "targets": [str(REPO / "tf_operator_tpu")]}
+    assert summary["status"] == "pass"
+    assert summary["targets"] == [str(REPO / "tf_operator_tpu"),
+                                  str(REPO / "tests")]
+    assert summary["findings_json"] == [
+        str(tmp_path / "junit" / "lint-findings.json"),
+        str(tmp_path / "junit" / "lint-findings-tests.json"),
+    ]
+    for path in summary["findings_json"]:
+        doc = json.loads(Path(path).read_text())
+        assert doc["count"] == 0 and doc["findings"] == []
+        assert doc["version"] == 1
     assert not (tmp_path / "junit" / "lint.xml").exists()
 
 
@@ -99,6 +110,11 @@ def test_lint_tier_fails_on_findings(tmp_path):
     summary = json.loads(
         (tmp_path / "junit" / "lint-summary.json").read_text())
     assert summary["status"] == "fail"
+    # the failing finding is in the uploaded machine-readable document too
+    doc = json.loads(
+        (tmp_path / "junit" / "lint-findings.json").read_text())
+    assert doc["count"] == 1
+    assert doc["findings"][0]["rule"] == "bare-lock"
 
 
 def test_crashing_retry_is_not_a_pass(tmp_path, monkeypatch):

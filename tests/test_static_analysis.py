@@ -44,6 +44,11 @@ FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
         ("bad_thread.py", "bad_thread.py", "thread-hygiene", 7),
         ("bad_guarded.py", "bad_guarded.py", "guarded-by", 12),
         ("bad_requires_lock.py", "bad_requires_lock.py", "guarded-by", 15),
+        ("bad_lock_order.py", "bad_lock_order.py", "lock-order", 16),
+        ("bad_guarded_interproc.py", "bad_guarded_interproc.py",
+         "guarded-by-interproc", 17),
+        ("bad_atomicity.py", "bad_atomicity.py", "atomicity", 19),
+        ("bad_sleep_poll.py", "tests/bad_sleep_poll.py", "sleep-poll", 9),
     ],
 )
 def test_rule_fires_exactly_once(fixture, rel_path, rule, line):
@@ -307,6 +312,382 @@ def test_guarded_by_checks_closures_defined_in_init():
     assert [(f.rule, f.line) for f in findings] == [("guarded-by", 6)]
 
 
+def test_lock_order_sees_through_call_chains():
+    """Holding A while *calling* a helper that acquires B is the same edge
+    as holding A while nesting `with B:` — the cycle must be found even
+    when one leg is interprocedural."""
+    src = (
+        "from tf_operator_tpu.utils import locks\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = locks.new_lock('a')\n"
+        "        self._b = locks.new_lock('b')\n"
+        "    def _take_b(self):\n"
+        "        with self._b:\n"
+        "            pass\n"
+        "    def forward(self):\n"
+        "        with self._a:\n"
+        "            self._take_b()\n"
+        "    def backward(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    findings = analysis.check_source(src, "x.py")
+    assert [f.rule for f in findings] == ["lock-order"], "\n".join(
+        f.render() for f in findings)
+    assert "C._a" in findings[0].message and "C._b" in findings[0].message
+    # consistent order in both methods: no cycle
+    clean = src.replace(
+        "    def backward(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n",
+        "    def backward(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n",
+    )
+    assert analysis.check_source(clean, "x.py") == []
+
+
+def test_lock_order_suppressed_by_any_edge_allow():
+    src = (
+        "from tf_operator_tpu.utils import locks\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = locks.new_lock('a')\n"
+        "        self._b = locks.new_lock('b')\n"
+        "    def ab(self):\n"
+        "        with self._a:\n"
+        "            with self._b:  # lint: allow(lock-order) — justified\n"
+        "                pass\n"
+        "    def ba(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    assert analysis.check_source(src, "x.py") == []
+
+
+def test_guarded_interproc_respects_requires_lock_and_locked_callers():
+    """A helper reading a guarded field is clean when every chain to it
+    holds the lock (annotation or call-site `with`); it fires only when an
+    unlocked chain exists."""
+    locked_chain = (
+        "from tf_operator_tpu.utils import locks\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = locks.new_lock('c')\n"
+        "        self._items = []  # guarded-by: _lock\n"
+        "    def snapshot(self):\n"
+        "        with self._lock:\n"
+        "            return self._collect()\n"
+        "    def _collect(self):\n"
+        "        return list(self._items)\n"
+    )
+    assert analysis.check_source(locked_chain, "x.py") == []
+    unlocked_entry = locked_chain.replace(
+        "    def snapshot(self):\n"
+        "        with self._lock:\n"
+        "            return self._collect()\n",
+        "    def snapshot(self):\n"
+        "        return self._collect()\n",
+    )
+    findings = analysis.check_source(unlocked_entry, "x.py")
+    assert [f.rule for f in findings] == ["guarded-by-interproc"]
+    assert "C.snapshot -> C._collect" in findings[0].message
+    # suppression on the access line silences it
+    suppressed = unlocked_entry.replace(
+        "        return list(self._items)\n",
+        "        return list(self._items)  # lint: allow(guarded-by-interproc) — torn read is benign here\n",
+    )
+    assert analysis.check_source(suppressed, "x.py") == []
+
+
+def test_guarded_interproc_tracks_locks_inside_except_handlers():
+    """An except handler's `with self._lock:` must count as held — the
+    handler body is statements like any other (ExceptHandler is not an
+    ast.stmt, which once dropped held tracking there)."""
+    src = (
+        "from tf_operator_tpu.utils import locks\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = locks.new_lock('c')\n"
+        "        self._items = []  # guarded-by: _lock\n"
+        "    def snapshot(self, op):\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except ValueError:\n"
+        "            with self._lock:\n"
+        "                return list(self._items)\n"
+    )
+    assert analysis.check_source(src, "x.py") == []
+    unlocked = src.replace(
+        "            with self._lock:\n"
+        "                return list(self._items)\n",
+        "            return list(self._items)\n",
+    )
+    assert [f.rule for f in analysis.check_source(unlocked, "x.py")] == [
+        "guarded-by-interproc"]
+
+
+def test_guarded_interproc_reports_subscript_slice_read_once():
+    """A guarded-field read in a subscript slice must produce ONE finding,
+    not one from the write-target scan plus one from the child scan."""
+    src = (
+        "from tf_operator_tpu.utils import locks\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = locks.new_lock('c')\n"
+        "        self._idx = 0  # guarded-by: _lock\n"
+        "        self._map = {}\n"
+        "    def put(self, v):\n"
+        "        self._map[self._idx] = v\n"
+    )
+    findings = analysis.check_source(src, "x.py")
+    assert [(f.rule, f.line) for f in findings] == [
+        ("guarded-by-interproc", 8)], "\n".join(f.render() for f in findings)
+
+
+def test_lock_order_allow_does_not_hide_other_cycles():
+    """Suppressing one edge removes only that edge from the graph: a
+    DIFFERENT cycle sharing a lock must still report."""
+    src = (
+        "from tf_operator_tpu.utils import locks\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = locks.new_lock('a')\n"
+        "        self._b = locks.new_lock('b')\n"
+        "        self._c = locks.new_lock('c')\n"
+        "    def ab(self):\n"
+        "        with self._a:\n"
+        "            with self._b:  # lint: allow(lock-order) — justified\n"
+        "                pass\n"
+        "    def ba(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+        "    def ac(self):\n"
+        "        with self._a:\n"
+        "            with self._c:\n"
+        "                pass\n"
+        "    def ca(self):\n"
+        "        with self._c:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    findings = analysis.check_source(src, "x.py")
+    assert [f.rule for f in findings] == ["lock-order"], "\n".join(
+        f.render() for f in findings)
+    assert "C._c" in findings[0].message  # the a<->c cycle survived
+
+
+def test_lock_order_sees_multi_item_with():
+    """`with self._a, self._b:` acquires b while holding a — the same
+    edge as the nested form, and the same deadlock against b->a."""
+    src = (
+        "from tf_operator_tpu.utils import locks\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = locks.new_lock('a')\n"
+        "        self._b = locks.new_lock('b')\n"
+        "    def ab(self):\n"
+        "        with self._a, self._b:\n"
+        "            pass\n"
+        "    def ba(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    findings = analysis.check_source(src, "x.py")
+    assert [f.rule for f in findings] == ["lock-order"], "\n".join(
+        f.render() for f in findings)
+
+
+def test_lock_order_allow_covers_only_its_own_site():
+    """Two sites witnessing the SAME edge: an allow on one must not
+    silence the cycle through the other, unjustified site."""
+    src = (
+        "from tf_operator_tpu.utils import locks\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._a = locks.new_lock('a')\n"
+        "        self._b = locks.new_lock('b')\n"
+        "    def ab_ok(self):\n"
+        "        with self._a:\n"
+        "            with self._b:  # lint: allow(lock-order) — justified\n"
+        "                pass\n"
+        "    def ab_bad(self):\n"
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n"
+        "    def ba(self):\n"
+        "        with self._b:\n"
+        "            with self._a:\n"
+        "                pass\n"
+    )
+    findings = analysis.check_source(src, "x.py")
+    assert [f.rule for f in findings] == ["lock-order"], "\n".join(
+        f.render() for f in findings)
+    # suppressing BOTH forward sites removes the edge and the cycle
+    both = src.replace(
+        "        with self._a:\n"
+        "            with self._b:\n"
+        "                pass\n",
+        "        with self._a:\n"
+        "            with self._b:  # lint: allow(lock-order) — also ok\n"
+        "                pass\n",
+    )
+    assert analysis.check_source(both, "x.py") == []
+
+
+def test_atomicity_sees_base_class_guarded_fields():
+    """Check-then-act in a subclass on a field the BASE declared
+    guarded must fire like it would in the base itself."""
+    src = (
+        "from tf_operator_tpu.utils import locks\n"
+        "class Base:\n"
+        "    def __init__(self):\n"
+        "        self._lock = locks.new_lock('base')\n"
+        "        self._slots = {}  # guarded-by: _lock\n"
+        "class Child(Base):\n"
+        "    def put_once(self, key, value):\n"
+        "        with self._lock:\n"
+        "            present = key in self._slots\n"
+        "        if not present:\n"
+        "            with self._lock:\n"
+        "                self._slots[key] = value\n"
+    )
+    findings = analysis.check_source(src, "x.py")
+    assert [(f.rule, f.line) for f in findings] == [("atomicity", 12)], \
+        "\n".join(f.render() for f in findings)
+
+
+def test_sleep_poll_ignores_nested_function_scopes():
+    """A sleep inside a callback DEFINED in the loop never runs in the
+    loop (no finding); a compare hidden in a nested def bounds nothing
+    (still a finding)."""
+    callback_sleep = (
+        "import time\n"
+        "def collect(done, cbs):\n"
+        "    while not done():\n"
+        "        cbs.append(lambda: time.sleep(1))\n"
+        "        done = done\n"
+    )
+    hidden_compare = (
+        "import time\n"
+        "def wait(p):\n"
+        "    while not p():\n"
+        "        def bound():\n"
+        "            return time.time() < 99\n"
+        "        time.sleep(0.01)\n"
+    )
+    assert analysis.check_source(callback_sleep, "tests/x.py") == []
+    assert [f.rule for f in analysis.check_source(hidden_compare,
+                                                  "tests/x.py")] == [
+        "sleep-poll"]
+
+
+def test_sleep_poll_reports_nested_unbounded_loops_once():
+    src = (
+        "import time\n"
+        "def wait(p):\n"
+        "    while True:\n"
+        "        while not p():\n"
+        "            time.sleep(0.01)\n"
+    )
+    findings = analysis.check_source(src, "tests/x.py")
+    assert [(f.rule, f.line) for f in findings] == [("sleep-poll", 5)]
+
+
+def test_guarded_interproc_does_not_double_report_writes():
+    """Unprotected WRITES stay the intraprocedural rule's findings — the
+    interprocedural rule must not duplicate them."""
+    src = (
+        "from tf_operator_tpu.utils import locks\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = locks.new_lock('c')\n"
+        "        self._items = []  # guarded-by: _lock\n"
+        "    def add(self, v):\n"
+        "        self._items.append(v)\n"
+    )
+    findings = analysis.check_source(src, "x.py")
+    assert [f.rule for f in findings] == ["guarded-by"]
+
+
+def test_atomicity_accepts_revalidated_double_check():
+    """Re-reading the field inside the write's critical section is the
+    documented fix; the rule must not fire on it (the package's gang
+    scheduler admission uses exactly this shape)."""
+    findings = analysis.check_file(str(FIXTURES / "bad_atomicity.py"))
+    assert [(f.rule, f.line) for f in findings] == [("atomicity", 19)]
+    # i.e. put_checked (line 26+) produced nothing — pinned by exactly-once
+
+
+def test_sleep_poll_scope_and_shapes():
+    bounded = (
+        "import time\n"
+        "def wait(p, timeout=5.0):\n"
+        "    deadline = time.time() + timeout\n"
+        "    while time.time() < deadline:\n"
+        "        if p():\n"
+        "            return True\n"
+        "        time.sleep(0.01)\n"
+        "    return p()\n"
+    )
+    unbounded = (
+        "import time\n"
+        "def wait(p):\n"
+        "    while not p():\n"
+        "        time.sleep(0.01)\n"
+    )
+    sync_until_shape = (  # deadline check in the body, `while True` head
+        "import time\n"
+        "def wait(p, timeout=5.0):\n"
+        "    deadline = time.time() + timeout\n"
+        "    while True:\n"
+        "        if p():\n"
+        "            return True\n"
+        "        if time.time() >= deadline:\n"
+        "            return False\n"
+        "        time.sleep(0.01)\n"
+    )
+    bounded_for = (
+        "import time\n"
+        "def settle():\n"
+        "    for _ in range(3):\n"
+        "        time.sleep(0.01)\n"
+    )
+    assert analysis.check_source(bounded, "tests/x.py") == []
+    assert analysis.check_source(sync_until_shape, "tests/x.py") == []
+    assert analysis.check_source(bounded_for, "tests/x.py") == []
+    assert [f.rule for f in analysis.check_source(unbounded, "tests/x.py")] \
+        == ["sleep-poll"]
+    # test_*.py basenames are in scope even without a tests/ dir segment
+    assert [f.rule for f in analysis.check_source(unbounded, "test_x.py")] \
+        == ["sleep-poll"]
+    # control-plane code is out of scope (its loops block on events)
+    assert analysis.check_source(unbounded, "runtime/x.py") == []
+    # from-imported alias can't evade
+    aliased = unbounded.replace("import time\n", "from time import sleep\n")
+    aliased = aliased.replace("time.sleep", "sleep")
+    assert [f.rule for f in analysis.check_source(aliased, "tests/x.py")] \
+        == ["sleep-poll"]
+
+
+def test_tests_tree_has_zero_sleep_poll_findings():
+    """The satellite pin: the repo's own test suite contains no unbounded
+    sleep-polls (known-bad fixtures excluded)."""
+    findings = [
+        f for f in analysis.check_package(
+            str(REPO / "tests"), exclude_dirs=["lint_fixtures"])
+        if f.rule == analysis.RULE_SLEEP_POLL
+    ]
+    assert findings == [], "\n".join(f.render("tests/") for f in findings)
+
+
 # ---------------------------------------------------------------------------
 # 2. the package pin — the CI gate
 
@@ -344,6 +725,93 @@ def test_cli_exit_codes(tmp_path):
     assert dirty.returncode == 1
     assert "[bare-lock]" in dirty.stdout
     assert "__init__.py:2" in dirty.stdout
+
+
+def test_cli_json_output_schema(tmp_path):
+    """--json writes the documented machine-readable findings document
+    (docs/static-analysis.md): version, target, count, findings[]."""
+    import json
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    bad = tmp_path / "badpkg"
+    bad.mkdir()
+    (bad / "__init__.py").write_text(
+        "import threading\n_l = threading.Lock()\n")
+    out = tmp_path / "findings.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.analysis", str(bad),
+         "--json", str(out)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 1
+    doc = json.loads(out.read_text())
+    assert doc["version"] == analysis.FINDINGS_JSON_VERSION
+    assert doc["count"] == 1
+    assert doc["findings"] == [{
+        "rule": "bare-lock", "path": "__init__.py", "line": 2,
+        "message": doc["findings"][0]["message"],
+    }]
+    assert "new_lock" in doc["findings"][0]["message"]
+    # clean run still writes the document (count 0) — CI parses it blindly
+    clean_out = tmp_path / "clean.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.analysis",
+         str(PACKAGE_DIR), "--json", str(clean_out)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(clean_out.read_text())
+    assert doc["count"] == 0 and doc["findings"] == []
+
+
+def test_cli_rules_filter_and_exclude(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    pkg = tmp_path / "tests"
+    pkg.mkdir()
+    (pkg / "test_poll.py").write_text(
+        "import time\nimport threading\n"
+        "_l = threading.Lock()\n"          # bare-lock: filtered out
+        "def wait(p):\n"
+        "    while not p():\n"
+        "        time.sleep(0.01)\n"       # sleep-poll: reported
+    )
+    fixtures = pkg / "lint_fixtures"
+    fixtures.mkdir()
+    (fixtures / "bad.py").write_text(
+        "import time\n"
+        "def wait(p):\n"
+        "    while not p():\n"
+        "        time.sleep(0.01)\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.analysis", str(pkg),
+         "--rules", "sleep-poll", "--exclude", "lint_fixtures"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 1
+    assert "[sleep-poll]" in proc.stdout
+    assert "[bare-lock]" not in proc.stdout      # filtered
+    assert "lint_fixtures" not in proc.stdout    # excluded
+    assert "1 finding(s)" in proc.stdout
+    # unknown rule ids are an error, not a silent no-op filter
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.analysis", str(pkg),
+         "--rules", "no-such-rule"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode != 0
+    assert "no-such-rule" in proc.stderr
+    # parse-error survives any filter: an unparseable file is never clean
+    (pkg / "test_broken.py").write_text("def f(:\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.analysis", str(pkg),
+         "--rules", "bare-lock", "--exclude", "lint_fixtures"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 1
+    assert "[parse-error]" in proc.stdout
 
 
 # ---------------------------------------------------------------------------
@@ -458,6 +926,83 @@ def test_cross_thread_release_does_not_poison_nesting():
             pass
     assert registry.pair_orders() == set()  # no phantom (a, b)
     assert len(registry.hold_times("a")) == 1  # the handoff hold was recorded
+
+
+def test_inversion_cycles_detects_three_lock_cycle():
+    """The pairwise check can NEVER see a 3-way inversion (no pair occurs
+    in both orders); full cycle detection must — with the witness cycle."""
+    with locks.instrumented() as registry:
+        a = locks.new_lock("a")
+        b = locks.new_lock("b")
+        c = locks.new_lock("c")
+
+        def nest(outer, inner):
+            with outer:
+                with inner:
+                    pass
+
+        for i, (outer, inner) in enumerate([(a, b), (b, c), (c, a)]):
+            t = threading.Thread(target=nest, args=(outer, inner),
+                                 name=f"tpujob-test-cycle-{i}", daemon=True)
+            t.start()
+            t.join(timeout=5)
+    assert registry.pair_orders() == {("a", "b"), ("b", "c"), ("c", "a")}
+    # no pair in both orders — the OLD pairwise definition saw nothing here
+    assert not any((y, x) in registry.pair_orders()
+                   for x, y in registry.pair_orders())
+    assert registry.inversion_cycles() == [["a", "b", "c"]]
+    assert registry.inversions() == {("a", "b"), ("b", "c"), ("a", "c")}
+
+
+def test_inversion_cycles_ignores_edges_outside_the_cycle():
+    """An acyclic tail hanging off a 2-cycle must not be reported as part
+    of the inversion."""
+    with locks.instrumented() as registry:
+        a = locks.new_lock("a")
+        b = locks.new_lock("b")
+        d = locks.new_lock("d")
+        with a:
+            with b:
+                pass
+        with b:
+            with d:  # acyclic tail
+                pass
+
+        def inverted():
+            with b:
+                with a:
+                    pass
+
+        t = threading.Thread(target=inverted, name="tpujob-test-invert",
+                             daemon=True)
+        t.start()
+        t.join(timeout=5)
+    assert registry.inversion_cycles() == [["a", "b"]]
+    assert registry.inversions() == {("a", "b")}
+
+
+def test_inversions_complete_when_one_component_has_two_cycles():
+    """a⇄b plus a⇄c collapse into ONE strongly-connected component; the
+    edge-level inversions() view must still report both pairs (the old
+    pairwise behavior), not just the component's single witness cycle."""
+    with locks.instrumented() as registry:
+        a = locks.new_lock("a")
+        b = locks.new_lock("b")
+        c = locks.new_lock("c")
+
+        def nest(outer, inner):
+            with outer:
+                with inner:
+                    pass
+
+        for i, (outer, inner) in enumerate(
+                [(a, b), (b, a), (a, c), (c, a)]):
+            t = threading.Thread(target=nest, args=(outer, inner),
+                                 name=f"tpujob-test-two-{i}", daemon=True)
+            t.start()
+            t.join(timeout=5)
+    assert registry.inversions() == {("a", "b"), ("a", "c")}
+    assert len(registry.inversion_cycles()) == 1  # one witness per SCC
 
 
 def test_instrumented_locked_works_for_rlock_too():

@@ -28,9 +28,28 @@ machine-check the concurrency discipline the code relies on:
                   their `def` line count as holding the lock in their body,
                   and their `self.<helper>()` call sites are checked.
                   Module-level globals work the same with bare names.
+  sleep-poll      (tests scope only) `time.sleep` inside a `while` loop
+                  with no wall/monotonic-clock deadline comparison anywhere
+                  in the loop — the unbounded-poll flaky-test smell
+                  `tests/testutil.py:sync_until` exists to prevent.
+
+Three further rules are interprocedural and package-wide, built from a
+whole-program call graph + lock-acquisition graph (`analysis/lockgraph.py`):
+
+  lock-order            cycle in the may-hold-while-acquiring graph (the
+                        static deadlock precondition), reported with the
+                        full witness path;
+  guarded-by-interproc  a `# guarded-by:` field READ via a call chain on
+                        which no caller holds the declared lock (writes
+                        stay the per-file rule's job);
+  atomicity             check-then-act: a guarded field read under one
+                        `with <lock>:` and written under a different
+                        acquisition of the same lock in the same function.
 
 Suppression: `# lint: allow(<rule>)` on the statement's header line (the
 line the statement starts on; for an `except` clause, the `except` line).
+A `lock-order` cycle is suppressed when any of its edges' acquisition
+sites carries the allow.
 
 The checker is pure stdlib `ast` + source-line comment scanning, so it runs
 in milliseconds with no pytest machinery — see `build/run_tests.py --tier
@@ -40,16 +59,25 @@ findings and pins each rule's firing behavior on known-bad fixtures).
 from __future__ import annotations
 
 import ast
+import json
 import os
 import re
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import lockgraph
+from .lockgraph import (
+    RULE_ATOMICITY,
+    RULE_GUARDED_INTERPROC,
+    RULE_LOCK_ORDER,
+)
 
 RULE_BARE_LOCK = "bare-lock"
 RULE_WALL_CLOCK = "wall-clock"
 RULE_SWALLOW = "swallow"
 RULE_THREAD_HYGIENE = "thread-hygiene"
 RULE_GUARDED_BY = "guarded-by"
+RULE_SLEEP_POLL = "sleep-poll"
 # not a style rule: an unparseable file cannot be checked, which must
 # surface as a finding (exit 1), never as a traceback
 RULE_PARSE_ERROR = "parse-error"
@@ -60,8 +88,15 @@ ALL_RULES = (
     RULE_SWALLOW,
     RULE_THREAD_HYGIENE,
     RULE_GUARDED_BY,
+    RULE_SLEEP_POLL,
+    RULE_LOCK_ORDER,
+    RULE_GUARDED_INTERPROC,
+    RULE_ATOMICITY,
     RULE_PARSE_ERROR,
 )
+
+# Schema version of the --json findings document (docs/static-analysis.md).
+FINDINGS_JSON_VERSION = 1
 
 # Subpackages (relative to the package root) where wall-clock reads are
 # banned.  train/ and ops/ are workload-side (they run inside pods, where
@@ -135,7 +170,8 @@ def _is_self_attr(node: ast.AST, attr: Optional[str] = None) -> bool:
 
 
 class _FileChecker:
-    def __init__(self, source: str, rel_path: str) -> None:
+    def __init__(self, source: str, rel_path: str,
+                 test_scope: Optional[bool] = None) -> None:
         self.rel_path = rel_path.replace(os.sep, "/")
         self.comments = _Comments(source)
         self.tree = ast.parse(source, filename=self.rel_path)
@@ -147,6 +183,14 @@ class _FileChecker:
             part in WALL_CLOCK_SCOPES
             for part in self.rel_path.split("/")[:-1]
         )
+        # sleep-poll scope: test code only (a `tests` dir segment or a
+        # test_*.py file); the caller can force it when the lint root IS
+        # the tests directory, where rel paths carry no `tests` segment
+        if test_scope is None:
+            parts = self.rel_path.split("/")
+            test_scope = ("tests" in parts[:-1]
+                          or parts[-1].startswith("test_"))
+        self.in_test_scope = test_scope
         # line -> header line of the innermost statement covering it, so a
         # suppression on a multi-line statement's first line covers a
         # violating expression that starts on a continuation line
@@ -168,6 +212,10 @@ class _FileChecker:
         self.time_modules: Set[str] = {"time"}
         # names bound to the time.time function itself
         self.time_funcs: Set[str] = set()
+        # names bound to time.sleep / time.monotonic-family functions
+        # (sleep-poll rule raw material)
+        self.sleep_funcs: Set[str] = set()
+        self.clock_read_funcs: Set[str] = set()
         for node in ast.walk(self.tree):
             if isinstance(node, ast.Import):
                 for alias in node.names:
@@ -185,6 +233,11 @@ class _FileChecker:
                     for alias in node.names:
                         if alias.name == "time":
                             self.time_funcs.add(alias.asname or alias.name)
+                        elif alias.name == "sleep":
+                            self.sleep_funcs.add(alias.asname or alias.name)
+                        elif alias.name in ("monotonic", "perf_counter"):
+                            self.clock_read_funcs.add(
+                                alias.asname or alias.name)
 
     # -- entry point ---------------------------------------------------
 
@@ -197,6 +250,7 @@ class _FileChecker:
             elif isinstance(node, ast.ExceptHandler):
                 self._check_swallow(node)
         self._check_timers()
+        self._check_sleep_poll()
         self._check_guarded_module(self.tree)
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ClassDef):
@@ -320,6 +374,72 @@ class _FileChecker:
                         "cannot be named (t.name = \"tpujob-<role>\") or "
                         "made a daemon",
                     )
+
+    # -- sleep-poll ----------------------------------------------------
+
+    def _is_sleep_call(self, node: ast.AST) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        func = node.func
+        if (isinstance(func, ast.Attribute) and func.attr == "sleep"
+                and isinstance(func.value, ast.Name)
+                and func.value.id in self.time_modules):
+            return True
+        return isinstance(func, ast.Name) and func.id in self.sleep_funcs
+
+    def _is_clock_read(self, node: ast.AST) -> bool:
+        """A wall/monotonic clock read: time.time()/monotonic()/
+        perf_counter(), clock.now(), or a from-imported alias of one."""
+        if not isinstance(node, ast.Call):
+            return False
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if (func.attr in ("time", "monotonic", "perf_counter")
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in self.time_modules):
+                return True
+            if func.attr == "now":  # clock.now() / <fake>.now()
+                return True
+        return (isinstance(func, ast.Name)
+                and (func.id in self.time_funcs
+                     or func.id in self.clock_read_funcs))
+
+    def _check_sleep_poll(self) -> None:
+        """`time.sleep` in a `while` loop whose subtree never compares a
+        clock read — an unbounded poll that hangs forever instead of
+        failing with a diagnosable timeout.  Test scope only (the control
+        plane has no business sleeping in loops at all; its loops block on
+        Events/Conditions, and the thread rules keep them visible)."""
+        if not self.in_test_scope:
+            return
+        reported: Set[int] = set()  # sleep-call node ids (nested loops
+        # both match the same sleep; one finding per sleep, not per loop)
+        for loop in ast.walk(self.tree):
+            if not isinstance(loop, ast.While):
+                continue
+            # _scope_walk, not ast.walk: a sleep inside a function/lambda
+            # DEFINED in the loop body does not run in the loop, and a
+            # clock compare hidden in one bounds nothing — both would
+            # mislead the full-subtree scan
+            sleeps = [n for n in self._scope_walk(loop)
+                      if self._is_sleep_call(n) and id(n) not in reported]
+            if not sleeps:
+                continue
+            deadline_checked = any(
+                isinstance(n, ast.Compare)
+                and any(self._is_clock_read(sub)
+                        for sub in ast.walk(n))
+                for n in self._scope_walk(loop)
+            )
+            if not deadline_checked:
+                reported.update(id(n) for n in sleeps)
+                self._report(
+                    RULE_SLEEP_POLL, sleeps[0],
+                    "time.sleep in a while loop with no deadline check; "
+                    "poll against a clock deadline (or use "
+                    "tests/testutil.py sync_until) so a hang fails fast "
+                    "with a diagnosable timeout",
+                )
 
     # -- wall-clock ----------------------------------------------------
 
@@ -588,38 +708,162 @@ class _FileChecker:
             self._walk_module_guarded(child, child_held, guarded, declared_at)
 
 
-def check_source(source: str, rel_path: str) -> List[Finding]:
+def _suppressed(checker: _FileChecker, line: int, rule: str) -> bool:
+    header = checker.stmt_header.get(line, line)
+    return (checker.comments.allows(line, rule)
+            or checker.comments.allows(header, rule))
+
+
+def _project_findings(checkers: List[_FileChecker]) -> List[Finding]:
+    """The interprocedural pass (lock-order / guarded-by-interproc /
+    atomicity) over every successfully parsed file, with the same
+    header-line suppression semantics as the per-file rules."""
+    by_path = {c.rel_path: c for c in checkers}
+    project = lockgraph.build_project(
+        [(c.rel_path, c.tree, c.comments) for c in checkers])
+    findings: List[Finding] = []
+
+    def lock_order_edge_allowed(path: str, line: int) -> bool:
+        checker = by_path.get(path)
+        return (checker is not None
+                and _suppressed(checker, line, RULE_LOCK_ORDER))
+
+    # suppressed edges are removed BEFORE cycle detection: an allow breaks
+    # exactly the cycles through that edge, and any OTHER cycle in the
+    # same component still reports
+    for cycle in project.lock_order_cycles(lock_order_edge_allowed):
+        hops = " -> ".join(
+            f"{a} ({path}:{line} {detail})"
+            for a, _b, path, line, detail in cycle)
+        first = cycle[0]
+        findings.append(Finding(
+            RULE_LOCK_ORDER, first[2], first[3],
+            f"potential deadlock: lock acquisition cycle {hops} -> "
+            f"{first[0]}; impose one global order (or break an edge and "
+            "suppress it with a justification)",
+        ))
+
+    for cls, fn, access, lock, chain in project.unguarded_reads():
+        checker = by_path.get(cls.path)
+        if checker is not None and _suppressed(checker, access.line,
+                                               RULE_GUARDED_INTERPROC):
+            continue
+        via = " -> ".join(f"{cls.name}.{m}" for m in chain)
+        findings.append(Finding(
+            RULE_GUARDED_INTERPROC, cls.path, access.line,
+            f"self.{access.attr} (guarded-by {lock}) read without the lock"
+            f" — reachable lock-free via {via}; hold `with self.{lock}:` "
+            "for the read or annotate the chain with `# requires-lock: "
+            f"{lock}`",
+        ))
+
+    for cls, fn, read, write, lock in project.check_then_act():
+        checker = by_path.get(cls.path)
+        if checker is not None and (
+                _suppressed(checker, write.line, RULE_ATOMICITY)
+                or _suppressed(checker, read.line, RULE_ATOMICITY)):
+            continue
+        findings.append(Finding(
+            RULE_ATOMICITY, cls.path, write.line,
+            f"check-then-act on self.{write.attr} (guarded-by {lock}): "
+            f"read under `with self.{lock}:` at line {read.line}, lock "
+            f"released, then written under a new acquisition in "
+            f"{cls.name}.{fn.name}; merge into one critical section or "
+            "re-validate the read",
+        ))
+    return findings
+
+
+def _check_many(files: Sequence[Tuple[str, str]],
+                test_scope: Optional[bool] = None,
+                rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Per-file rules + the interprocedural pass over `(rel_path, source)`
+    pairs; unparseable files surface as parse-error findings and drop out
+    of the project model.  When a `rules` subset is given that names no
+    interprocedural rule, the whole-program pass is skipped entirely —
+    the CI tests-tree sleep-poll pass must not pay for a call-graph
+    fixpoint whose findings it would discard."""
+    findings: List[Finding] = []
+    checkers: List[_FileChecker] = []
+    for rel_path, source in files:
+        try:
+            checker = _FileChecker(source, rel_path, test_scope=test_scope)
+        except SyntaxError as err:
+            findings.append(Finding(
+                RULE_PARSE_ERROR, rel_path.replace(os.sep, "/"),
+                err.lineno or 0, f"cannot parse module: {err.msg}",
+            ))
+            continue
+        findings.extend(checker.run())
+        checkers.append(checker)
+    wanted = None if rules is None else set(rules)
+    if wanted is None or wanted & set(lockgraph.LOCKGRAPH_RULES):
+        findings.extend(_project_findings(checkers))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def check_source(source: str, rel_path: str,
+                 test_scope: Optional[bool] = None) -> List[Finding]:
     """Lint one module's source.  `rel_path` is the path relative to the
-    package root (it decides wall-clock scoping, e.g. "runtime/x.py").
-    An unparseable module yields a single `parse-error` finding."""
-    try:
-        return _FileChecker(source, rel_path).run()
-    except SyntaxError as err:
-        return [Finding(
-            RULE_PARSE_ERROR, rel_path.replace(os.sep, "/"),
-            err.lineno or 0, f"cannot parse module: {err.msg}",
-        )]
+    package root (it decides wall-clock scoping, e.g. "runtime/x.py", and
+    sleep-poll's tests scope).  The interprocedural rules run over the
+    single-file project.  An unparseable module yields a single
+    `parse-error` finding."""
+    return _check_many([(rel_path, source)], test_scope=test_scope)
 
 
-def check_file(path: str, rel_path: Optional[str] = None) -> List[Finding]:
+def check_file(path: str, rel_path: Optional[str] = None,
+               test_scope: Optional[bool] = None) -> List[Finding]:
     with open(path, encoding="utf-8") as f:
         source = f.read()
-    return check_source(source, rel_path or os.path.basename(path))
+    return check_source(source, rel_path or os.path.basename(path),
+                        test_scope=test_scope)
 
 
-def check_package(root: str) -> List[Finding]:
-    """Lint every .py under the package directory `root`."""
-    findings: List[Finding] = []
+def check_package(root: str,
+                  exclude_dirs: Iterable[str] = (),
+                  rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint every .py under the package directory `root` (per-file rules
+    file by file, interprocedural rules over the whole tree).  Directory
+    names in `exclude_dirs` are pruned (e.g. known-bad fixture dirs);
+    `rules` (when given) lets _check_many skip the whole-program pass if
+    no interprocedural rule is requested — the caller still post-filters
+    the per-file findings."""
+    skip = {"__pycache__", *exclude_dirs}
+    files: List[Tuple[str, str]] = []
     for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        dirnames[:] = sorted(d for d in dirnames if d not in skip)
         for filename in sorted(filenames):
             if not filename.endswith(".py"):
                 continue
             path = os.path.join(dirpath, filename)
-            rel = os.path.relpath(path, root)
-            findings.extend(check_file(path, rel))
-    findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return findings
+            with open(path, encoding="utf-8") as f:
+                files.append((os.path.relpath(path, root), f.read()))
+    # when the lint root IS a tests tree, rel paths carry no `tests`
+    # segment — force the scope so sleep-poll still arms
+    root_is_tests = os.path.basename(os.path.abspath(root)) == "tests"
+    return _check_many(files, test_scope=True if root_is_tests else None,
+                       rules=rules)
+
+
+def write_findings_json(path: str, findings: List[Finding],
+                        target: str) -> None:
+    """Machine-readable findings document (schema: version, target, count,
+    findings[{rule, path, line, message}] — docs/static-analysis.md)."""
+    doc = {
+        "version": FINDINGS_JSON_VERSION,
+        "target": target,
+        "count": len(findings),
+        "findings": [
+            {"rule": f.rule, "path": f.path, "line": f.line,
+             "message": f.message}
+            for f in findings
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
 
 
 def resolve_package_dir(spec: str) -> Tuple[str, str]:
@@ -645,11 +889,33 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("package", nargs="?", default="tf_operator_tpu",
                         help="package name or directory to lint "
                              "(default: tf_operator_tpu)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to report (default: "
+                             "all; parse-error always reports)")
+    parser.add_argument("--exclude", default=None,
+                        help="comma-separated directory names to skip "
+                             "(e.g. lint_fixtures)")
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="also write machine-readable findings to PATH "
+                             "(schema in docs/static-analysis.md)")
     args = parser.parse_args(argv)
 
     root, prefix = resolve_package_dir(args.package)
-    findings = check_package(root)
+    exclude = [d for d in (args.exclude or "").split(",") if d]
+    wanted: Optional[Set[str]] = None
+    if args.rules is not None:
+        wanted = {r for r in args.rules.split(",") if r}
+        unknown = wanted - set(ALL_RULES)
+        if unknown:
+            raise SystemExit(f"unknown rule(s): {', '.join(sorted(unknown))}")
+        # an unparseable file can never be claimed clean under any filter
+        wanted.add(RULE_PARSE_ERROR)
+    findings = check_package(root, exclude_dirs=exclude, rules=wanted)
+    if wanted is not None:
+        findings = [f for f in findings if f.rule in wanted]
     for finding in findings:
         print(finding.render(prefix))
     print(f"{len(findings)} finding(s) in {prefix.rstrip('/')}")
+    if args.json is not None:
+        write_findings_json(args.json, findings, prefix.rstrip("/"))
     return 1 if findings else 0

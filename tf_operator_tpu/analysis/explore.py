@@ -1,0 +1,450 @@
+"""Deterministic interleaving explorer: the lint's dynamic layer.
+
+The static rules (`analysis/lockgraph.py`) prove properties of the lock
+*structure*; they cannot prove that the informer's delete-tombstone
+invariant holds under an adversarial watch-vs-relist interleaving, or that
+the sharded queue never loses a key when add/add_after/done race a drain.
+This module runs those small multi-threaded scenarios under a cooperative
+scheduler that OWNS the interleaving: exactly one scenario thread runs at a
+time, every `InstrumentedLock` acquire/release (via the
+`utils.locks.set_explore_hook` seam) and every explicit
+`explore.yield_point()` is a scheduling decision, and the decisions are
+drawn from a seeded RNG — so a run is a *schedule*, a failing schedule is a
+reproducible artifact (seed + decision trace), and `replay(scenario,
+trace)` re-executes it exactly.
+
+What a schedule can catch:
+
+  - invariant violations (`Scenario.check` raises, or a scenario thread
+    asserts) — e.g. a tombstoned object resurrected by a stale LIST;
+  - deadlocks: every unfinished thread blocked on a lock a peer holds —
+    reported with the who-waits-on-whom detail;
+  - lock-order inversions: each schedule runs inside
+    `locks.instrumented()`, and a non-empty
+    `registry.inversion_cycles()` fails the schedule even when the timing
+    dodged the actual deadlock;
+  - livelock/budget overrun (a schedule exceeding `max_steps` decisions).
+
+Granularity: code under an instrumented lock is atomic *between* its lock
+operations (one running thread + the GIL), so lock-free scenario steps
+should be separated with explicit `yield_point()` calls at the boundaries
+the scenario wants permuted.  Structures serialized by a raw Condition
+(e.g. the workqueue — conditions are never instrumented) interleave at
+method granularity via those explicit points, which is exactly the
+granularity their one-lock design makes meaningful.
+
+Scenario threads may spawn real helper threads (a queue's requeue
+dispatcher, say); those run unmanaged on the raw lock path — the explorer
+only schedules its own threads, and treats a lock held by a foreign thread
+as "retry later", never as a deadlock participant.
+
+Each schedule runs under a fresh `FakeClock` (installed via `clock.use`) so
+`clock.now()`-driven logic is schedule-controlled, not wall-time-controlled;
+`time.monotonic()` still advances for real, which only matters for
+scenarios that encode duration thresholds — keep those thresholds at 0 or
+huge, as the scenarios in `tests/test_schedule_explorer.py` do.
+
+Budget: `explore(scenario, schedules=N, seed=S)` runs N independent
+schedules.  Tier-1 uses a few hundred per scenario (sub-second each); the
+slow tier's `ANALYSIS_EXPLORE_BUDGET` env var scales N up for deep sweeps
+(see docs/static-analysis.md).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ..utils import clock, locks
+
+# A single scheduling step should be microseconds; a scenario thread that
+# fails to reach its next yield point within this many seconds is stuck in
+# a genuinely blocking call the explorer cannot control (a raw
+# Condition.wait, say) — surfaced as a hard error, not a hang.
+STEP_TIMEOUT = 60.0
+
+# Decision budget per schedule: generous for small scenarios, small enough
+# that a livelocked schedule fails in milliseconds, not minutes.
+DEFAULT_MAX_STEPS = 5000
+
+FAIL_INVARIANT = "invariant"
+FAIL_DEADLOCK = "deadlock"
+FAIL_EXCEPTION = "exception"
+FAIL_INVERSION = "lock-inversion"
+FAIL_BUDGET = "budget"
+
+
+class InvariantViolation(AssertionError):
+    """Raised by `Scenario.check` (or scenario thread asserts) when a
+    schedule produced an illegal state."""
+
+
+class Scenario:
+    """One explorable concurrency scenario.  Subclass and override:
+
+      name       identifier used in reports
+      build()    fresh state for ONE schedule (never shared across runs)
+      threads(state)
+                 [(thread name, zero-arg callable)] — the racing bodies
+      check(state)
+                 post-schedule invariant; raise InvariantViolation
+      cleanup(state)
+                 optional teardown (stop helper threads etc.)
+    """
+
+    name = "scenario"
+
+    def build(self):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def threads(self, state) -> Sequence[Tuple[str, Callable[[], None]]]:  # pragma: no cover
+        raise NotImplementedError
+
+    def check(self, state) -> None:
+        pass
+
+    def cleanup(self, state) -> None:
+        pass
+
+
+@dataclass
+class ScheduleFailure:
+    scenario: str
+    schedule_index: int   # which schedule (seed offset) failed
+    seed: int             # the explore() seed that produced it
+    kind: str             # FAIL_* above
+    detail: str
+    trace: List[str] = field(default_factory=list)  # decision sequence
+
+    def render(self) -> str:
+        return (
+            f"scenario {self.scenario!r}: {self.kind} at schedule "
+            f"#{self.schedule_index} (seed={self.seed}, "
+            f"{len(self.trace)} decisions)\n  {self.detail}\n"
+            f"  replay trace: {self.trace}"
+        )
+
+
+@dataclass
+class ExploreResult:
+    scenario: str
+    schedules: int  # schedules actually executed
+    failure: Optional[ScheduleFailure]
+
+    @property
+    def ok(self) -> bool:
+        return self.failure is None
+
+
+# The run currently driving managed threads (exactly one at a time; the
+# explorer is not itself reentrant).  Written only from the driving thread
+# while every managed thread is parked, so plain writes are safe.
+_active_run: Optional["_Run"] = None
+
+
+def yield_point() -> None:
+    """Explicit scheduling point for scenario code: a no-op outside the
+    explorer, a yield-to-scheduler inside it.  Put one between the scenario
+    steps whose interleavings matter."""
+    run = _active_run
+    if run is None:
+        return
+    task = run.current_task()
+    if task is not None:
+        run.pause(task)
+
+
+class _AbortSchedule(BaseException):
+    """Raised inside parked scenario threads to unwind them (releasing
+    their `with` blocks on the way out) once the schedule's verdict is in —
+    a deadlocked schedule would otherwise leave threads parked forever.
+    BaseException so scenario code's `except Exception` cannot absorb it."""
+
+
+class _Task:
+    def __init__(self, name: str, fn: Callable[[], None]) -> None:
+        self.name = name
+        self.fn = fn
+        self.go = threading.Event()
+        self.started = threading.Event()
+        self.done = False
+        self.error: Optional[BaseException] = None
+        self.error_tb = ""
+        self.blocked_on = None  # the InstrumentedLock we failed to acquire
+        self.thread: Optional[threading.Thread] = None
+
+
+class _Run(locks.ExploreHook):
+    """One schedule's cooperative scheduler + the locks.py hook."""
+
+    def __init__(self, specs: Sequence[Tuple[str, Callable[[], None]]]) -> None:
+        self.tasks = [_Task(name, fn) for name, fn in specs]
+        self._by_ident: Dict[int, _Task] = {}
+        self._ctrl = threading.Event()
+        # id(lock) -> (task, hold depth) for locks managed tasks hold
+        self._holders: Dict[int, Tuple[_Task, int]] = {}
+        self.trace: List[str] = []
+        self._aborting = False  # set once the schedule's verdict is in
+
+    # -- hook surface (called from managed scenario threads) -----------
+
+    def manages_current_thread(self) -> bool:
+        return threading.get_ident() in self._by_ident
+
+    def current_task(self) -> Optional[_Task]:
+        return self._by_ident.get(threading.get_ident())
+
+    def pause(self, task: _Task) -> None:
+        """Hand control to the scheduler; resumes when scheduled again.
+        During an abort it raises instead, unwinding the thread (with-block
+        releases run on the way out, so held locks are returned)."""
+        if self._aborting:
+            raise _AbortSchedule()
+        self._ctrl.set()
+        task.go.wait()
+        task.go.clear()
+        if self._aborting:
+            raise _AbortSchedule()
+
+    def cooperative_acquire(self, lock) -> bool:
+        task = self._by_ident[threading.get_ident()]
+        self.pause(task)  # the acquire itself is a scheduling point
+        while True:
+            if lock._inner.acquire(blocking=False):
+                held = self._holders.get(id(lock))
+                depth = held[1] + 1 if held is not None else 1
+                self._holders[id(lock)] = (task, depth)
+                return True
+            task.blocked_on = lock
+            self.pause(task)
+            task.blocked_on = None
+
+    def on_release(self, lock) -> None:
+        task = self._by_ident.get(threading.get_ident())
+        if task is None:
+            return
+        held = self._holders.get(id(lock))
+        if held is not None and held[0] is task:
+            if held[1] > 1:
+                self._holders[id(lock)] = (task, held[1] - 1)
+            else:
+                del self._holders[id(lock)]
+        self.pause(task)  # post-release: let a waiter grab it first
+
+    # -- thread bodies -------------------------------------------------
+
+    def _task_main(self, task: _Task) -> None:
+        self._by_ident[threading.get_ident()] = task
+        task.started.set()
+        task.go.wait()
+        task.go.clear()
+        try:
+            task.fn()
+        except _AbortSchedule:
+            pass  # deliberate unwind, not a scenario error
+        except BaseException as err:  # lint: allow(swallow) — re-raised by the driver as a schedule failure
+            task.error = err
+            task.error_tb = traceback.format_exc()
+        finally:
+            self._by_ident.pop(threading.get_ident(), None)
+            task.done = True
+            self._ctrl.set()
+
+    # -- the drive loop (runs on the exploring thread) -----------------
+
+    def _runnable(self, task: _Task) -> bool:
+        if task.done:
+            return False
+        lock = task.blocked_on
+        if lock is None:
+            return True
+        held = self._holders.get(id(lock))
+        # Held by a managed peer: not runnable until that peer releases.
+        # Held by a foreign (unmanaged) thread or free: runnable — the task
+        # retries its try-acquire when scheduled.
+        return held is None or held[0] is task
+
+    def drive(self, choose: Callable[[List[_Task]], _Task],
+              max_steps: int) -> Optional[Tuple[str, str]]:
+        """Run the schedule; returns (failure kind, detail) or None.
+        `choose` picks the next task from the (name-sorted) runnable list;
+        every choice is appended to self.trace."""
+        for task in self.tasks:
+            thread = threading.Thread(
+                target=self._task_main, args=(task,),
+                name=f"tpujob-explore-{task.name}", daemon=True)
+            task.thread = thread
+            thread.start()
+        for task in self.tasks:
+            if not task.started.wait(timeout=STEP_TIMEOUT):
+                return ("error", f"thread {task.name} never started")
+
+        steps = 0
+        while any(not t.done for t in self.tasks):
+            runnable = sorted(
+                (t for t in self.tasks if self._runnable(t)),
+                key=lambda t: t.name)
+            if not runnable:
+                detail = "; ".join(
+                    f"{t.name} waits on lock {t.blocked_on.name!r} "
+                    f"held by "
+                    f"{self._holders[id(t.blocked_on)][0].name}"
+                    for t in self.tasks
+                    if not t.done and t.blocked_on is not None
+                )
+                return (FAIL_DEADLOCK,
+                        f"all live threads blocked: {detail}")
+            task = choose(runnable)
+            self.trace.append(task.name)
+            self._ctrl.clear()
+            task.go.set()
+            if not self._ctrl.wait(timeout=STEP_TIMEOUT):
+                raise RuntimeError(
+                    f"scenario thread {task.name} did not reach a yield "
+                    f"point within {STEP_TIMEOUT}s — it is stuck in a "
+                    "blocking call the explorer cannot schedule (raw "
+                    "Condition.wait?); restructure the scenario to poll")
+            steps += 1
+            if steps > max_steps:
+                return (FAIL_BUDGET,
+                        f"schedule exceeded {max_steps} decisions "
+                        "(livelock, or raise max_steps)")
+        return None
+
+    def abort(self) -> None:
+        """Unwind every still-parked thread (deadlocked schedules leave
+        them blocked forever otherwise).  Idempotent; a no-op when all
+        tasks already finished."""
+        if all(task.done for task in self.tasks):
+            return
+        self._aborting = True
+        deadline = time.monotonic() + 10.0
+        while (any(not task.done for task in self.tasks)
+               and time.monotonic() < deadline):
+            for task in self.tasks:
+                if not task.done:
+                    task.go.set()
+            time.sleep(0.0005)  # let the unwinding daemon threads run
+
+    def join_all(self) -> None:
+        for task in self.tasks:
+            if task.thread is not None:
+                task.thread.join(timeout=5.0)
+
+
+def _run_one_schedule(scenario: Scenario,
+                      choose: Callable[[List[_Task]], _Task],
+                      max_steps: int,
+                      schedule_index: int,
+                      seed: int) -> Optional[ScheduleFailure]:
+    global _active_run
+
+    def failure(kind: str, detail: str,
+                trace: List[str]) -> ScheduleFailure:
+        return ScheduleFailure(
+            scenario=scenario.name, schedule_index=schedule_index,
+            seed=seed, kind=kind, detail=detail, trace=trace)
+
+    # FakeClock built OUTSIDE instrumented(): its internal lock must stay
+    # raw, or every clock.now() would add noise decisions to the schedule.
+    fake = clock.FakeClock()
+    with clock.use(fake):
+        with locks.instrumented() as registry:
+            state = scenario.build()
+            try:
+                run = _Run(scenario.threads(state))
+                previous_hook = locks.set_explore_hook(run)
+                _active_run = run
+                try:
+                    outcome = run.drive(choose, max_steps)
+                finally:
+                    _active_run = None
+                    locks.set_explore_hook(previous_hook)
+                    run.abort()  # unparks what a failed schedule left blocked
+                    run.join_all()
+                if outcome is not None:
+                    return failure(outcome[0], outcome[1], run.trace)
+                for task in run.tasks:
+                    if task.error is not None:
+                        kind = (FAIL_INVARIANT
+                                if isinstance(task.error, AssertionError)
+                                else FAIL_EXCEPTION)
+                        return failure(
+                            kind,
+                            f"thread {task.name}: "
+                            f"{task.error!r}\n{task.error_tb}",
+                            run.trace)
+                cycles = registry.inversion_cycles()
+                if cycles:
+                    return failure(
+                        FAIL_INVERSION,
+                        f"lock acquisition-order cycle(s): {cycles}",
+                        run.trace)
+                try:
+                    scenario.check(state)
+                except AssertionError as err:
+                    return failure(FAIL_INVARIANT, str(err) or repr(err),
+                                   run.trace)
+                except Exception as err:  # lint: allow(swallow) — converted to a ScheduleFailure the caller raises on
+                    # A racy schedule can corrupt state so badly check()
+                    # crashes before any assert (KeyError on a dropped
+                    # entry, say).  That is still this schedule's verdict
+                    # — keep the seed/trace artifact instead of letting a
+                    # raw traceback escape without it.
+                    return failure(
+                        FAIL_EXCEPTION,
+                        f"check() raised {err!r}\n{traceback.format_exc()}",
+                        run.trace)
+            finally:
+                # Unconditional: even when drive() raised (stuck thread),
+                # the scenario's helpers must not leak into the next
+                # schedule — that diagnostic path needs teardown MOST.
+                scenario.cleanup(state)
+    return None
+
+
+def explore(scenario: Scenario, schedules: int = 200, seed: int = 0,
+            max_steps: int = DEFAULT_MAX_STEPS) -> ExploreResult:
+    """Run `schedules` independent seeded schedules of `scenario`; stop at
+    the first failing one.  Fully deterministic: the same (scenario, seed,
+    schedules) triple always explores the same schedules in the same
+    order, so a failure's schedule_index and trace are stable artifacts."""
+    for index in range(schedules):
+        rng = random.Random(seed * 1_000_003 + index)
+
+        def choose(runnable: List[_Task]) -> _Task:
+            return runnable[rng.randrange(len(runnable))]
+
+        fail = _run_one_schedule(scenario, choose, max_steps, index, seed)
+        if fail is not None:
+            return ExploreResult(scenario=scenario.name,
+                                 schedules=index + 1, failure=fail)
+    return ExploreResult(scenario=scenario.name, schedules=schedules,
+                         failure=None)
+
+
+def replay(scenario: Scenario, trace: Sequence[str],
+           max_steps: int = DEFAULT_MAX_STEPS) -> Optional[ScheduleFailure]:
+    """Re-execute one recorded decision trace.  Returns the reproduced
+    failure, or None if the trace no longer fails (the bug moved)."""
+    decisions: Iterator[str] = iter(trace)
+
+    def choose(runnable: List[_Task]) -> _Task:
+        try:
+            wanted = next(decisions)
+        except StopIteration:
+            # Past the recorded prefix (the original failed mid-run):
+            # deterministic fallback keeps the run finishable.
+            return runnable[0]
+        for task in runnable:
+            if task.name == wanted:
+                return task
+        # Divergence (code changed since the trace was recorded): keep
+        # going deterministically rather than crash the replay.
+        return runnable[0]
+
+    return _run_one_schedule(scenario, choose, max_steps,
+                             schedule_index=-1, seed=-1)

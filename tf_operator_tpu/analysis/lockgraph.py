@@ -1,0 +1,804 @@
+"""Whole-program lock analysis: the interprocedural layer of the lint.
+
+The per-file rules in `tf_operator_tpu.analysis` are deliberately
+intraprocedural — they check each statement against the lock it can see.
+This module builds a package-wide model and checks the properties that only
+exist *between* functions and files:
+
+  lock-order            a cycle in the may-hold-while-acquiring graph — the
+                        static deadlock precondition.  Nodes are lock
+                        *declarations* (`self.X = locks.new_lock("name")`
+                        sites, including `new_rlock`/`new_condition` and
+                        module-level locks); edges mean "some code path
+                        acquires B while holding A", from `with`-block
+                        nesting plus call chains.  Reported once per cycle
+                        with the full witness path and the file:line of
+                        every edge.
+  guarded-by-interproc  a `# guarded-by:` field READ on a call chain along
+                        which no caller holds the declared lock.  The
+                        intraprocedural `guarded-by` rule owns writes; this
+                        rule closes the read side: a public method (or a
+                        helper only reachable from one) that snapshots a
+                        guarded map without the lock sees torn state.
+  atomicity             check-then-act on a guarded field: the field is
+                        read under one `with <lock>:` block and written
+                        under a *different* acquisition of the same lock in
+                        the same function — the lock was released between
+                        the check and the act, so the read may be stale by
+                        the time the write lands.
+
+Model (kept deliberately simple, like the per-file rules):
+
+  - A "lock" is an attribute or module global assigned from
+    `locks.new_lock/new_rlock/new_condition(...)`.  The node id is the
+    declaring `Class.attr` (or `module:name`), displayed with the runtime
+    name hint; f-string names keep their literal prefix (`informer-*`).
+  - Calls resolve to: `self.m()` (own class + bases), module functions,
+    `self.attr.m()` where `self.attr = SomeClass(...)` in `__init__`, and
+    `var.m()` where `var = SomeClass(...)` earlier in the same function.
+    Anything else (duck-typed callbacks, externals) is out of the graph —
+    the dynamic layer (`analysis/explore.py`) covers what this misses.
+  - Held-lock tracking is syntactic `with` nesting plus `# requires-lock:`
+    entry assumptions; `Condition.wait()`'s release-while-waiting is not
+    modeled.  Nested function bodies are not analyzed here (the per-file
+    rules already check their writes with an empty held set).
+
+Suppressions work like every other rule (`# lint: allow(<rule>)` on the
+statement's header line); a `lock-order` cycle is suppressed when ANY of
+its edges' acquisition sites carries the allow — a justified edge breaks
+the cycle.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import (Callable, Dict, FrozenSet, List, Optional, Sequence,
+                    Set, Tuple)
+
+from ..utils import graph as graphlib
+
+RULE_LOCK_ORDER = "lock-order"
+RULE_GUARDED_INTERPROC = "guarded-by-interproc"
+RULE_ATOMICITY = "atomicity"
+
+LOCKGRAPH_RULES = (RULE_LOCK_ORDER, RULE_GUARDED_INTERPROC, RULE_ATOMICITY)
+
+_LOCK_FACTORIES = {"new_lock", "new_rlock", "new_condition"}
+
+# In-place mutator methods — kept in sync with the per-file checker's list.
+_MUTATORS = {
+    "add", "append", "appendleft", "clear", "discard", "extend", "insert",
+    "pop", "popitem", "popleft", "remove", "reverse", "setdefault", "sort",
+    "update",
+}
+
+_ENTRY_SESSION = -1  # "held at entry" (requires-lock) — not a with block
+
+
+def _is_self_attr(node: ast.AST, attr: Optional[str] = None) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and (attr is None or node.attr == attr)
+    )
+
+
+def _lock_name_hint(call: ast.Call) -> str:
+    """The runtime lock name passed to the factory: a literal, or the
+    literal parts of an f-string with `*` for the formatted holes."""
+    if not call.args:
+        return "?"
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        parts = []
+        for value in arg.values:
+            if isinstance(value, ast.Constant):
+                parts.append(str(value.value))
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return "?"
+
+
+def _iter_mro(cls: "_ClassModel", resolve_base):
+    """`cls` followed by its base chain: single inheritance, first
+    resolvable base per class, cycle-guarded.  `resolve_base` maps a base
+    name to a `_ClassModel` or None — the ONE place base resolution lives;
+    every lock/guarded/method/attr-type lookup walks through here."""
+    seen: Set[str] = set()
+    current: Optional["_ClassModel"] = cls
+    while current is not None and current.name not in seen:
+        seen.add(current.name)
+        yield current
+        nxt = None
+        for base in current.bases:
+            candidate = resolve_base(base)
+            if candidate is not None:
+                nxt = candidate
+                break
+        current = nxt
+
+
+def _is_lock_factory_call(node: ast.AST) -> Optional[ast.Call]:
+    """The Call node when `node` is `locks.new_*(...)` / `new_*(...)`."""
+    if not isinstance(node, ast.Call):
+        return None
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in _LOCK_FACTORIES:
+        return node
+    if isinstance(func, ast.Name) and func.id in _LOCK_FACTORIES:
+        return node
+    return None
+
+
+@dataclass
+class LockDecl:
+    lock_id: str    # "Class.attr" or "module.py:name"
+    hint: str       # runtime name hint ("sync-health", "informer-*", ...)
+    path: str
+    line: int
+
+    @property
+    def display(self) -> str:
+        return f"{self.lock_id}[{self.hint}]"
+
+
+@dataclass
+class _Access:
+    attr: str
+    write: bool
+    line: int
+    held: FrozenSet[str]                 # lock attrs held at this point
+    sessions: Tuple[Tuple[str, int], ...]  # (lock attr, with-session id)
+
+
+@dataclass
+class _CallSite:
+    # ("self", method) | ("func", name) | ("attr", self_attr, method)
+    # | ("var", class_name, method)
+    target: Tuple[str, ...]
+    line: int
+    held: FrozenSet[str]
+
+
+@dataclass
+class _Acquire:
+    lock_attr: str
+    line: int
+    held_before: FrozenSet[str]
+
+
+@dataclass
+class _FuncModel:
+    name: str
+    line: int
+    requires: Optional[str] = None
+    accesses: List[_Access] = field(default_factory=list)
+    calls: List[_CallSite] = field(default_factory=list)
+    acquires: List[_Acquire] = field(default_factory=list)
+
+
+@dataclass
+class _ClassModel:
+    name: str
+    path: str
+    bases: List[str] = field(default_factory=list)
+    locks: Dict[str, LockDecl] = field(default_factory=dict)      # attr ->
+    guarded: Dict[str, str] = field(default_factory=dict)         # attr -> lock attr
+    attr_types: Dict[str, str] = field(default_factory=dict)      # attr -> class name
+    methods: Dict[str, _FuncModel] = field(default_factory=dict)
+
+
+@dataclass
+class _ModuleModel:
+    path: str
+    locks: Dict[str, LockDecl] = field(default_factory=dict)      # global -> decl
+    classes: Dict[str, _ClassModel] = field(default_factory=dict)
+    functions: Dict[str, _FuncModel] = field(default_factory=dict)
+
+
+class _FuncWalker:
+    """Extract one function's lock behavior: acquisitions, guarded-field
+    accesses, resolvable call sites — with `with`-nesting held tracking."""
+
+    def __init__(self, func: ast.AST, cls: Optional[_ClassModel],
+                 module: _ModuleModel, requires: Optional[str]) -> None:
+        self.cls = cls
+        self.module = module
+        self.model = _FuncModel(name=func.name, line=func.lineno,
+                                requires=requires)
+        self.local_types: Dict[str, str] = {}  # var -> class name
+        # write-ish Attribute node ids: assign/del targets (incl. subscript
+        # bases) and mutator receivers — excluded from the read scan
+        self._write_nodes: Set[int] = set()
+        # guarded-attr map incl. inherited, computed ONCE (the class model
+        # is fully built before any method is walked)
+        self._guarded = self._all_guarded()
+        held: Dict[str, int] = {}
+        if requires:
+            held[requires] = _ENTRY_SESSION
+        self._walk_body(list(ast.iter_child_nodes(func)), held)
+
+    # -- helpers -------------------------------------------------------
+
+    def _known_lock(self, attr: str) -> bool:
+        if self.cls is not None and self._resolve_lock_attr(attr) is not None:
+            return True
+        return attr in self.module.locks
+
+    def _resolve_lock_attr(self, attr: str) -> Optional[LockDecl]:
+        """Lock decl for `self.<attr>`, searching base classes too (the
+        subclass's `with self._lock:` refers to the parent's decl)."""
+        if self.cls is None:
+            return None
+        for cls in _iter_mro(self.cls, self.module.classes.get):
+            if attr in cls.locks:
+                return cls.locks[attr]
+        return None
+
+    def _with_lock_attrs(self, node: ast.With) -> List[str]:
+        out = []
+        for item in node.items:
+            expr = item.context_expr
+            if _is_self_attr(expr) and self.cls is not None:
+                out.append(expr.attr)
+            elif isinstance(expr, ast.Name) and expr.id in self.module.locks:
+                out.append(expr.id)
+        return out
+
+    def _held_set(self, held: Dict[str, int]) -> FrozenSet[str]:
+        return frozenset(held)
+
+    def _sessions(self, held: Dict[str, int]) -> Tuple[Tuple[str, int], ...]:
+        return tuple(sorted(held.items()))
+
+    # -- the walk ------------------------------------------------------
+
+    def _walk_body(self, nodes: List[ast.AST], held: Dict[str, int]) -> None:
+        for node in nodes:
+            self._walk_stmt(node, held)
+
+    def _walk_stmt(self, node: ast.AST, held: Dict[str, int]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return  # nested scopes: out of the interprocedural model
+        if isinstance(node, ast.With):
+            taken = [a for a in self._with_lock_attrs(node)
+                     if a not in held]
+            child_held = dict(held)
+            for attr in taken:
+                # held_before accumulates the EARLIER items of this same
+                # statement: `with self._a, self._b:` acquires b while
+                # holding a, exactly like the nested form
+                self.model.acquires.append(_Acquire(
+                    lock_attr=attr, line=node.lineno,
+                    held_before=frozenset(child_held)))
+                child_held[attr] = node.lineno  # session id = with line
+            for item in node.items:
+                self._scan_expr(item.context_expr, held)
+            self._walk_body(list(node.body), child_held)
+            return
+        # local type bindings: var = ClassName(...)
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            func = node.value.func
+            if isinstance(func, ast.Name):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        self.local_types[target.id] = func.id
+        # guarded writes: mark target attribute nodes as write-ish
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for target in targets:
+                self._mark_write_target(target, node.lineno, held)
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                self._mark_write_target(target, node.lineno, held)
+        # everything else: expressions are scanned for calls/reads;
+        # statement-ish children (incl. ExceptHandler and other
+        # stmt containers, which are NOT ast.stmt) recurse with held
+        # tracking intact — an `except` body's `with self._lock:` must
+        # count like any other
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, held)
+            else:
+                self._walk_stmt(child, held)
+
+    def _mark_write_target(self, target: ast.AST, line: int,
+                           held: Dict[str, int]) -> None:
+        base = target
+        if isinstance(base, ast.Subscript):
+            # the slice is scanned by the generic child loop (exactly once)
+            base = base.value
+        if (_is_self_attr(base) and self.cls is not None
+                and base.attr in self._guarded):
+            self._write_nodes.add(id(base))
+            self.model.accesses.append(_Access(
+                attr=base.attr, write=True, line=line,
+                held=self._held_set(held), sessions=self._sessions(held)))
+
+    def _all_guarded(self) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        if self.cls is None:
+            return out
+        for cls in _iter_mro(self.cls, self.module.classes.get):
+            for k, v in cls.guarded.items():
+                out.setdefault(k, v)
+        return out
+
+    @staticmethod
+    def _expr_walk(node: ast.AST):
+        """ast.walk minus nested-scope subtrees: a lambda's body runs at
+        some later time on some other thread — locks held here prove
+        nothing there (mirrors the per-file rules' treatment)."""
+        stack = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.Lambda, ast.FunctionDef,
+                                ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            yield sub
+            stack.extend(ast.iter_child_nodes(sub))
+
+    def _scan_expr(self, node: ast.AST, held: Dict[str, int]) -> None:
+        for sub in self._expr_walk(node):
+            if isinstance(sub, ast.Call):
+                self._record_call(sub, held)
+            elif (isinstance(sub, ast.Attribute)
+                  and isinstance(sub.ctx, ast.Load)
+                  and _is_self_attr(sub)
+                  and id(sub) not in self._write_nodes
+                  and self.cls is not None
+                  and sub.attr in self._guarded):
+                self.model.accesses.append(_Access(
+                    attr=sub.attr, write=False, line=sub.lineno,
+                    held=self._held_set(held),
+                    sessions=self._sessions(held)))
+
+    def _record_call(self, node: ast.Call, held: Dict[str, int]) -> None:
+        func = node.func
+        held_set = self._held_set(held)
+        # mutator on a guarded attr: a write access, and its receiver load
+        # must not double as a read
+        if (isinstance(func, ast.Attribute) and func.attr in _MUTATORS
+                and _is_self_attr(func.value) and self.cls is not None
+                and func.value.attr in self._guarded):
+            self._write_nodes.add(id(func.value))
+            self.model.accesses.append(_Access(
+                attr=func.value.attr, write=True, line=node.lineno,
+                held=held_set, sessions=self._sessions(held)))
+            return
+        if isinstance(func, ast.Name):
+            self.model.calls.append(_CallSite(
+                target=("func", func.id), line=node.lineno, held=held_set))
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                self.model.calls.append(_CallSite(
+                    target=("self", func.attr), line=node.lineno,
+                    held=held_set))
+            elif base.id in self.local_types:
+                self.model.calls.append(_CallSite(
+                    target=("var", self.local_types[base.id], func.attr),
+                    line=node.lineno, held=held_set))
+        elif _is_self_attr(base):
+            # self.attr.method(); receiver load of a guarded attr counts as
+            # a read (handled by the generic scan), the call may resolve via
+            # the attr's constructor-assigned type
+            self.model.calls.append(_CallSite(
+                target=("attr", base.attr, func.attr), line=node.lineno,
+                held=held_set))
+
+
+def _build_module(tree: ast.Module, path: str, comments) -> _ModuleModel:
+    """`comments` is the per-file annotation index (allow/guarded/requires
+    line maps) built by the per-file checker."""
+    module = _ModuleModel(path=path)
+
+    # module-level locks and (future) globals
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            call = _is_lock_factory_call(
+                node.value if node.value is not None else ast.Constant(None))
+            if call is None:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for target in targets:
+                if isinstance(target, ast.Name):
+                    module.locks[target.id] = LockDecl(
+                        lock_id=f"{path}:{target.id}",
+                        hint=_lock_name_hint(call), path=path,
+                        line=node.lineno)
+
+    def requires_for(fn: ast.AST) -> Optional[str]:
+        return (comments.requires.get(fn.lineno)
+                or comments.requires.get(fn.lineno - 1))
+
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            cls = _ClassModel(
+                name=node.name, path=path,
+                bases=[b.id for b in node.bases if isinstance(b, ast.Name)])
+            module.classes[node.name] = cls
+            methods = [n for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            # declarations: self.X = locks.new_*(...), self.X = Class(...),
+            # and `# guarded-by:` annotations — from any method (__init__
+            # usually, but lazily-created locks exist too)
+            for method in methods:
+                for sub in ast.walk(method):
+                    if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    value = sub.value
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    self_attrs = [t.attr for t in targets
+                                  if _is_self_attr(t)]
+                    if not self_attrs or value is None:
+                        continue
+                    call = _is_lock_factory_call(value)
+                    for attr in self_attrs:
+                        if call is not None:
+                            cls.locks.setdefault(attr, LockDecl(
+                                lock_id=f"{node.name}.{attr}",
+                                hint=_lock_name_hint(call), path=path,
+                                line=sub.lineno))
+                        elif (isinstance(value, ast.Call)
+                              and isinstance(value.func, ast.Name)):
+                            cls.attr_types.setdefault(attr, value.func.id)
+                        lock = comments.guarded.get(sub.lineno)
+                        if lock:
+                            cls.guarded[attr] = lock
+            for method in methods:
+                walker = _FuncWalker(method, cls, module,
+                                     requires_for(method))
+                cls.methods[method.name] = walker.model
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            walker = _FuncWalker(node, None, module, requires_for(node))
+            module.functions[node.name] = walker.model
+
+    return module
+
+
+class _Project:
+    """Cross-module resolution + the three interprocedural rules."""
+
+    def __init__(self, modules: List[_ModuleModel]) -> None:
+        self.modules = modules
+        # class name -> models (usually one; duplicates resolve per-module
+        # first, then by unique package-wide name)
+        self.classes: Dict[str, List[_ClassModel]] = {}
+        for module in modules:
+            for cls in module.classes.values():
+                self.classes.setdefault(cls.name, []).append(cls)
+
+    def _class_named(self, name: str,
+                     prefer_module: _ModuleModel) -> Optional[_ClassModel]:
+        if name in prefer_module.classes:
+            return prefer_module.classes[name]
+        candidates = self.classes.get(name, [])
+        if len(candidates) == 1:
+            return candidates[0]
+        return None
+
+    def _module_of(self, cls: _ClassModel) -> _ModuleModel:
+        for module in self.modules:
+            if module.path == cls.path and cls.name in module.classes:
+                return module
+        raise KeyError(cls.name)  # pragma: no cover - construction invariant
+
+    def _base_resolver(self, module: _ModuleModel):
+        return lambda name: self._class_named(name, module)
+
+    def _resolve_method(self, cls: _ClassModel,
+                        name: str) -> Optional[Tuple[_ClassModel, _FuncModel]]:
+        module = self._module_of(cls)
+        for current in _iter_mro(cls, self._base_resolver(module)):
+            if name in current.methods:
+                return current, current.methods[name]
+        return None
+
+    def _resolve_lock(self, cls: Optional[_ClassModel],
+                      module: _ModuleModel,
+                      attr: str) -> Optional[LockDecl]:
+        if cls is not None:
+            for current in _iter_mro(cls, self._base_resolver(module)):
+                if attr in current.locks:
+                    return current.locks[attr]
+        return module.locks.get(attr)
+
+    def _resolve_call(self, cls: Optional[_ClassModel],
+                      module: _ModuleModel, call: _CallSite
+                      ) -> Optional[Tuple[Optional[_ClassModel], _FuncModel]]:
+        kind = call.target[0]
+        if kind == "self" and cls is not None:
+            resolved = self._resolve_method(cls, call.target[1])
+            if resolved is not None:
+                return resolved
+        elif kind == "func":
+            fn = module.functions.get(call.target[1])
+            if fn is not None:
+                return None, fn
+        elif kind == "attr" and cls is not None:
+            attr, method = call.target[1], call.target[2]
+            type_name = None
+            for current in _iter_mro(cls, self._base_resolver(module)):
+                if attr in current.attr_types:
+                    type_name = current.attr_types[attr]
+                    break
+            if type_name is not None:
+                target_cls = self._class_named(type_name, module)
+                if target_cls is not None:
+                    return self._resolve_method(target_cls, method)
+        elif kind == "var":
+            target_cls = self._class_named(call.target[1], module)
+            if target_cls is not None:
+                return self._resolve_method(target_cls, call.target[2])
+        return None
+
+    # -- lock-order ----------------------------------------------------
+
+    def lock_order_edges(self) -> Dict[Tuple[str, str],
+                                       List[Tuple[str, int, str]]]:
+        """(outer lock id, inner lock id) -> every (path, line, detail)
+        acquisition site witnessing the edge.  ALL sites are kept: an edge
+        is only suppressible when every one of its sites carries the
+        allow — one justified nesting must not silence an unjustified
+        nesting of the same pair elsewhere."""
+        # Step 1: per function, the set of lock decls it may acquire
+        # transitively (fixpoint over the resolved call graph).
+        func_key = id  # _FuncModel identity
+        direct: Dict[int, Set[str]] = {}
+        callees: Dict[int, Set[int]] = {}
+        owners: Dict[int, Tuple[Optional[_ClassModel], _ModuleModel,
+                                _FuncModel]] = {}
+        for module in self.modules:
+            scopes = [(None, fn) for fn in module.functions.values()]
+            scopes += [(cls, fn) for cls in module.classes.values()
+                       for fn in cls.methods.values()]
+            for cls, fn in scopes:
+                key = func_key(fn)
+                owners[key] = (cls, module, fn)
+                direct[key] = set()
+                callees[key] = set()
+                for acq in fn.acquires:
+                    decl = self._resolve_lock(cls, module, acq.lock_attr)
+                    if decl is not None:
+                        direct[key].add(decl.lock_id)
+                for call in fn.calls:
+                    resolved = self._resolve_call(cls, module, call)
+                    if resolved is not None:
+                        callees[key].add(func_key(resolved[1]))
+        acq_star: Dict[int, Set[str]] = {k: set(v) for k, v in direct.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key, callee_keys in callees.items():
+                for ck in callee_keys:
+                    extra = acq_star.get(ck, set()) - acq_star[key]
+                    if extra:
+                        acq_star[key].update(extra)
+                        changed = True
+
+        # Step 2: edges.  Intraprocedural nesting + held-across-call.
+        edges: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+
+        def add_edge(outer: str, inner: str, path: str, line: int,
+                     detail: str) -> None:
+            if outer == inner:
+                return  # re-entrant same-lock nesting is not an ordering
+            edges.setdefault((outer, inner), []).append((path, line, detail))
+
+        for key, (cls, module, fn) in owners.items():
+            where = f"{cls.name + '.' if cls else ''}{fn.name}"
+            for acq in fn.acquires:
+                inner = self._resolve_lock(cls, module, acq.lock_attr)
+                if inner is None:
+                    continue
+                for held_attr in acq.held_before:
+                    outer = self._resolve_lock(cls, module, held_attr)
+                    if outer is not None:
+                        add_edge(outer.lock_id, inner.lock_id, module.path,
+                                 acq.line, f"in {where}")
+            # a requires-lock entry is already seeded into every call
+            # site's held set by _FuncWalker, so held covers it
+            for call in fn.calls:
+                if not call.held:
+                    continue
+                resolved = self._resolve_call(cls, module, call)
+                if resolved is None:
+                    continue
+                inner_ids = acq_star.get(func_key(resolved[1]), set())
+                for held_attr in call.held:
+                    outer = self._resolve_lock(cls, module, held_attr)
+                    if outer is None:
+                        continue
+                    for inner_id in inner_ids:
+                        add_edge(outer.lock_id, inner_id, module.path,
+                                 call.line,
+                                 f"in {where} via call to "
+                                 f"{'.'.join(call.target[1:])}")
+        return edges
+
+    def lock_order_cycles(
+        self,
+        edge_allowed: Optional[Callable[[str, int], bool]] = None,
+    ) -> List[List[Tuple[str, str, str, int, str]]]:
+        """Cycles in the edge graph; each as a list of
+        (outer, inner, path, line, detail) edges, deterministic order —
+        one witness per strongly-connected component (fix one, rerun).
+
+        `edge_allowed(path, line)` names suppressed acquisition sites; an
+        edge drops out BEFORE cycle detection only when EVERY site
+        witnessing it is suppressed (one justified nesting cannot silence
+        an unjustified nesting of the same pair elsewhere), so an allow
+        breaks exactly the cycles through fully-justified edges and every
+        other cycle in the component still reports."""
+        edges = self.lock_order_edges()
+        if edge_allowed is not None:
+            filtered = {}
+            for pair, sites in edges.items():
+                live = [s for s in sites if not edge_allowed(s[0], s[1])]
+                if live:
+                    filtered[pair] = live
+            edges = filtered
+        out = []
+        for cycle in graphlib.witness_cycles(edges.keys()):
+            detail = []
+            for a, b in zip(cycle, cycle[1:] + cycle[:1]):
+                path, line, where = edges[(a, b)][0]
+                detail.append((a, b, path, line, where))
+            out.append(detail)
+        return out
+
+    # -- guarded-by-interproc ------------------------------------------
+
+    def unguarded_reads(self) -> List[Tuple[_ClassModel, _FuncModel,
+                                            _Access, str, List[str]]]:
+        """(class, method, read access, lock attr, witness chain) for every
+        guarded-field READ reachable on a chain where the lock is unheld."""
+        findings = []
+        for name in sorted(self.classes):
+            for cls in self.classes[name]:
+                findings.extend(self._class_unguarded_reads(cls))
+        return findings
+
+    def _merged_guarded(self, cls: _ClassModel) -> Dict[str, str]:
+        """attr -> lock attr, base-class declarations included — a field
+        declared `# guarded-by:` in the base is just as guarded in the
+        subclass's methods."""
+        module = self._module_of(cls)
+        guarded: Dict[str, str] = {}
+        for current in _iter_mro(cls, self._base_resolver(module)):
+            for k, v in current.guarded.items():
+                guarded.setdefault(k, v)
+        return guarded
+
+    def _class_unguarded_reads(self, cls: _ClassModel):
+        guarded = self._merged_guarded(cls)
+        if not guarded:
+            return []
+        locks_used = sorted(set(guarded.values()))
+
+        # intraclass callers: method -> [(caller, held at call site)]
+        callers: Dict[str, List[Tuple[str, FrozenSet[str]]]] = {}
+        for mname, fn in cls.methods.items():
+            for call in fn.calls:
+                if call.target[0] == "self":
+                    callers.setdefault(call.target[1], []).append(
+                        (mname, call.held))
+
+        findings = []
+        for lock in locks_used:
+            # fixpoint: methods enterable with `lock` NOT held, with one
+            # witness predecessor for the report
+            unlocked: Dict[str, Optional[str]] = {}
+            pending = []
+            for mname, fn in cls.methods.items():
+                if mname == "__init__" or fn.requires == lock:
+                    continue
+                is_entry = (not mname.startswith("_")
+                            or mname not in callers)
+                if is_entry:
+                    unlocked[mname] = None
+                    pending.append(mname)
+            while pending:
+                mname = pending.pop()
+                fn = cls.methods.get(mname)
+                if fn is None:
+                    continue
+                for call in fn.calls:
+                    if call.target[0] != "self":
+                        continue
+                    callee = call.target[1]
+                    target = cls.methods.get(callee)
+                    if (target is None or callee in unlocked
+                            or callee == "__init__"
+                            or target.requires == lock
+                            or lock in call.held):
+                        continue
+                    unlocked[callee] = mname
+                    pending.append(callee)
+
+            for mname, fn in cls.methods.items():
+                if mname not in unlocked:
+                    continue
+                for access in fn.accesses:
+                    if access.write:
+                        continue  # writes are the per-file rule's job
+                    if guarded.get(access.attr) != lock:
+                        continue
+                    if lock in access.held:
+                        continue
+                    chain = [mname]
+                    node = unlocked[mname]
+                    while node is not None:
+                        chain.append(node)
+                        node = unlocked.get(node)
+                    chain.reverse()
+                    findings.append((cls, fn, access, lock, chain))
+        return findings
+
+    # -- atomicity -----------------------------------------------------
+
+    def check_then_act(self) -> List[Tuple[_ClassModel, _FuncModel,
+                                           _Access, _Access, str]]:
+        """(class, method, read, write, lock attr): the read and the write
+        of one guarded field sit under *different* acquisitions of its lock
+        in the same function (the lock was released in between)."""
+        findings = []
+        for name in sorted(self.classes):
+            for cls in self.classes[name]:
+                guarded = self._merged_guarded(cls)
+                if not guarded:
+                    continue
+                for mname, fn in sorted(cls.methods.items()):
+                    if mname == "__init__":
+                        continue
+                    for attr, lock in sorted(guarded.items()):
+                        reads = [a for a in fn.accesses
+                                 if a.attr == attr and not a.write
+                                 and dict(a.sessions).get(lock) is not None]
+                        writes = [a for a in fn.accesses
+                                  if a.attr == attr and a.write
+                                  and dict(a.sessions).get(lock) is not None]
+                        for write in writes:
+                            w_sess = dict(write.sessions)[lock]
+                            if w_sess == _ENTRY_SESSION:
+                                continue
+                            prior = [r for r in reads
+                                     if r.line < write.line
+                                     and dict(r.sessions)[lock]
+                                     not in (w_sess, _ENTRY_SESSION)]
+                            # Double-checked pattern: a read of the same
+                            # field inside the write's own critical section
+                            # re-validates the stale check — that IS the
+                            # documented fix, so it must not fire.
+                            revalidated = any(
+                                r.line <= write.line
+                                and dict(r.sessions)[lock] == w_sess
+                                for r in reads)
+                            if prior and not revalidated:
+                                findings.append(
+                                    (cls, fn, prior[0], write, lock))
+                                break  # one finding per (method, attr)
+        return findings
+
+
+def build_project(files: Sequence[Tuple[str, ast.Module, object]]
+                  ) -> _Project:
+    """`files` is (rel_path, parsed tree, per-file comments index)."""
+    return _Project([
+        _build_module(tree, path, comments)
+        for path, tree, comments in files
+    ])

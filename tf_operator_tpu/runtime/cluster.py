@@ -236,10 +236,14 @@ class InMemoryCluster(ClusterInterface):
         # Hold a pod unbound only when a registered gang scheduler owns its
         # scheduler name.  A template-set scheduler_name with nobody admitting
         # it (e.g. pdb-mode gangs, custom names) must start normally, not hang
-        # Pending forever.
+        # Pending forever.  The registry read takes the (re-entrant) lock:
+        # create_pod calls this after releasing it, racing a concurrent
+        # register_gang_scheduler.
+        with self._lock:
+            owned = pod.spec.scheduler_name in self._gang_scheduler_names
         return bool(
             pod.spec.scheduler_name
-            and pod.spec.scheduler_name in self._gang_scheduler_names
+            and owned
             and pod.metadata.annotations.get(constants.GANG_GROUP_ANNOTATION)
         )
 

@@ -264,8 +264,10 @@ class FaultInjector:
     def describe(self) -> str:
         """Human-readable trace for chaos failure reports — paste-able next
         to the printed seed."""
-        lines = [f"seed={self.plan.seed} injected={len(self.trace)}"]
-        for rec in self.trace:
+        with self._lock:
+            trace = list(self.trace)
+        lines = [f"seed={self.plan.seed} injected={len(trace)}"]
+        for rec in trace:
             lines.append(
                 f"  #{rec.seq} [{rec.scope}] {rec.op} {rec.path}: "
                 f"{rec.fault.kind}"
@@ -276,8 +278,11 @@ class FaultInjector:
     def replay_script(self) -> List[Tuple[str, Fault]]:
         """The injected faults in order as (scope, fault) entries — feed to
         FaultPlan(script=...) to replay this exact schedule against the
-        same call sequence, each fault at the layer it originally hit."""
-        return [(rec.scope, rec.fault) for rec in self.trace]
+        same call sequence, each fault at the layer it originally hit.
+        Snapshot under the lock: a chaos test reads the script while the
+        controller's threads may still be injecting."""
+        with self._lock:
+            return [(rec.scope, rec.fault) for rec in self.trace]
 
 
 # ClusterInterface methods FaultyCluster intercepts.  Watches, events and

@@ -1,0 +1,131 @@
+"""Tiny directed-graph helpers shared by the lock analyses.
+
+Both the runtime `LockRegistry` (utils/locks.py) and the static lock-graph
+checker (analysis/lockgraph.py) need the same two questions answered about
+a may-hold-while-acquiring edge set: *is there a cycle* (each one is a
+deadlock precondition), and *show me one witness per tangle* so the report
+is readable.  One implementation, stdlib-only, deterministic output.
+
+Self-loops are out of scope — both callers exclude same-lock re-entry
+before building edges.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+Edge = Tuple[str, str]
+
+
+def _adjacency(pairs: Iterable[Edge]) -> Dict[str, List[str]]:
+    graph: Dict[str, List[str]] = {}
+    for a, b in sorted(set(pairs)):
+        graph.setdefault(a, []).append(b)
+        graph.setdefault(b, [])
+    return graph
+
+
+def strongly_connected(pairs: Iterable[Edge]) -> List[List[str]]:
+    """Nontrivial (size > 1) strongly-connected components, via iterative
+    Tarjan (recursion limits are nobody's friend inside test harnesses).
+    Deterministic: nodes are visited in sorted order."""
+    graph = _adjacency(pairs)
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    counter = [0]
+    sccs: List[List[str]] = []
+
+    def strongconnect(root: str) -> None:
+        work = [(root, iter(graph[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for succ in it:
+                if succ not in index:
+                    index[succ] = low[succ] = counter[0]
+                    counter[0] += 1
+                    stack.append(succ)
+                    on_stack.add(succ)
+                    work.append((succ, iter(graph[succ])))
+                    advanced = True
+                    break
+                if succ in on_stack:
+                    low[node] = min(low[node], index[succ])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                component = []
+                while True:
+                    member = stack.pop()
+                    on_stack.discard(member)
+                    component.append(member)
+                    if member == node:
+                        break
+                if len(component) > 1:
+                    sccs.append(component)
+
+    for name in sorted(graph):
+        if name not in index:
+            strongconnect(name)
+    return sccs
+
+
+def cycle_edges(pairs: Iterable[Edge]) -> Set[Edge]:
+    """Every edge that lies on SOME cycle — i.e. whose endpoints share a
+    strongly-connected component.  This is the complete answer (unlike one
+    witness per SCC): with a⇄b and a⇄c in one component, all four edges
+    report."""
+    pairs = set(pairs)
+    component: Dict[str, int] = {}
+    for i, scc in enumerate(strongly_connected(pairs)):
+        for node in scc:
+            component[node] = i
+    return {(a, b) for (a, b) in pairs
+            if a in component and b in component
+            and component[a] == component[b]}
+
+
+def witness_cycles(pairs: Iterable[Edge]) -> List[List[str]]:
+    """ONE witness cycle per nontrivial SCC, as its lock-name sequence
+    (the edge from the last back to the first closes it), rotated to start
+    at the smallest member, list sorted — a readable report, not an
+    enumeration (simple-cycle counts are exponential).  Use `cycle_edges`
+    when completeness matters."""
+    pairs = set(pairs)
+    graph = _adjacency(pairs)
+    cycles: List[List[str]] = []
+    for scc in strongly_connected(pairs):
+        members = set(scc)
+        start = min(members)
+        path = [start]
+        seen = {start}
+
+        def find_cycle() -> Optional[List[str]]:
+            node = path[-1]
+            for succ in graph[node]:
+                if succ == start and len(path) > 1:
+                    return list(path)
+                if succ in members and succ not in seen:
+                    seen.add(succ)
+                    path.append(succ)
+                    found = find_cycle()
+                    if found is not None:
+                        return found
+                    path.pop()
+                    seen.discard(succ)
+            return None
+
+        witness = find_cycle()
+        if witness is not None:
+            cycles.append(witness)
+    cycles.sort()
+    return cycles

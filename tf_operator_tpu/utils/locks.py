@@ -30,7 +30,42 @@ import time
 from contextlib import contextmanager
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
+from . import graph
+
 _registry: Optional["LockRegistry"] = None
+
+# Interleaving-explorer seam (tf_operator_tpu.analysis.explore): when a hook
+# is installed, InstrumentedLock routes blocking acquires of threads the hook
+# manages through `hook.cooperative_acquire(lock)` and reports releases via
+# `hook.on_release(lock)`, turning every lock operation into a scheduling
+# point the explorer controls.  Threads the hook does not manage (including
+# whatever real worker threads the system under test spawns) take the raw
+# path untouched.  Install/uninstall via set_explore_hook; like the registry
+# this is opt-in and test-only — production never installs a hook.
+_explore_hook: Optional["ExploreHook"] = None
+
+
+class ExploreHook:
+    """Protocol for the explorer's scheduling hook (duck-typed; this base
+    class documents the surface InstrumentedLock calls)."""
+
+    def manages_current_thread(self) -> bool:  # pragma: no cover - protocol
+        return False
+
+    def cooperative_acquire(self, lock: "InstrumentedLock") -> bool:  # pragma: no cover
+        raise NotImplementedError
+
+    def on_release(self, lock: "InstrumentedLock") -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+def set_explore_hook(hook: Optional[ExploreHook]) -> Optional[ExploreHook]:
+    """Install `hook` as the process-wide explorer seam; returns the
+    previous hook so callers can restore it (the explorer always does)."""
+    global _explore_hook
+    previous = _explore_hook
+    _explore_hook = hook
+    return previous
 
 
 def new_lock(name: str) -> "threading.Lock | InstrumentedLock":
@@ -73,7 +108,15 @@ class InstrumentedLock:
         self._hold_depth = 0  # int writes are atomic under the GIL
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        got = self._inner.acquire(blocking, timeout)
+        hook = _explore_hook
+        if (hook is not None and blocking and timeout == -1
+                and hook.manages_current_thread()):
+            # Explorer-managed thread: the hook schedules around the acquire
+            # (try-acquire + yield until obtainable), so one thread at a
+            # time runs and blocked-on-held is visible to its scheduler.
+            got = hook.cooperative_acquire(self)
+        else:
+            got = self._inner.acquire(blocking, timeout)
         if got:
             self._hold_depth += 1
             self._registry._on_acquire(self.name)
@@ -83,6 +126,9 @@ class InstrumentedLock:
         self._registry._on_release(self.name)
         self._hold_depth -= 1
         self._inner.release()
+        hook = _explore_hook
+        if hook is not None and hook.manages_current_thread():
+            hook.on_release(self)
 
     def locked(self) -> bool:
         # _thread.RLock grows .locked() only in Python 3.14; fall back to
@@ -180,14 +226,26 @@ class LockRegistry:
         with self._meta:
             return set(self._pairs)
 
+    def inversion_cycles(self) -> List[List[str]]:
+        """Witness cycles in the may-hold-while-acquiring graph — FULL cycle
+        detection, not just 2-cycles: three threads nesting a→b, b→c and
+        c→a never exhibit any pair in both orders, yet can deadlock
+        three-way.  One witness cycle per strongly-connected component
+        (readable report, not an enumeration — fix one and rerun), each as
+        its lock-name sequence rotated to start at the smallest name so
+        output is deterministic.  `inversions()` is the complete edge-level
+        view."""
+        return graph.witness_cycles(self.pair_orders())
+
     def inversions(self) -> Set[Tuple[str, str]]:
-        """Lock pairs acquired in both orders — each is a potential
-        deadlock.  Empty set == globally consistent acquisition order."""
-        with self._meta:
-            return {
-                (a, b) for (a, b) in self._pairs
-                if a < b and (b, a) in self._pairs
-            }
+        """Every normalized lock pair lying on an acquisition-order cycle —
+        each is a potential deadlock.  Complete (SCC edge membership, not
+        the one-witness-per-component cycles): a⇄b plus a⇄c reports both
+        {(a,b), (a,c)}, and a three-way a→b→c→a (no pair ever seen in both
+        orders) reports its cycle edges.  Empty set == globally consistent
+        acquisition order."""
+        return {(min(a, b), max(a, b))
+                for a, b in graph.cycle_edges(self.pair_orders())}
 
 
 @contextmanager
