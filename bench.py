@@ -56,7 +56,12 @@ BENCH_SKIP_ATTENTION=1, BENCH_SKIP_NATIVE=1, BENCH_LM_*, and for the k8s
 soak: BENCH_K8S_QPS/BENCH_K8S_BURST (client throttle), BENCH_K8S_SHARDS
 (reconcile shards, default 4), BENCH_K8S_SOAK_JOBS (default 100),
 BENCH_K8S_SOAK_1K=1 (adds the 1,000-job arm, k8s_soak_1000_jobs_sec +
-per-job apiserver request counts — docs/informer-cache.md).
+per-job apiserver request counts — docs/informer-cache.md),
+BENCH_K8S_SOAK_10K=1 (adds the 10,000-job FEDERATED-fleet arm:
+BENCH_K8S_REPLICAS shard-lease replicas, default 3, emitting
+k8s_soak_10000_jobs_sec, per-job status-write cost, and per-replica
+queue-latency p99 — docs/federation.md; BENCH_K8S_SOAK_10K_JOBS scales
+the job count for smoke runs).
 """
 from __future__ import annotations
 
@@ -1134,6 +1139,72 @@ def child_k8s_control_plane() -> None:
                 out[f"k8s_soak_{n1k}_jobs_sec"] = round(wall, 3)
                 out["k8s_soak_1k_api_requests_per_job"] = round(reqs / n1k, 2)
                 out["k8s_soak_1k_api_reads_per_job"] = round(gets / n1k, 2)
+
+        # 10,000-job arm (ROADMAP item 1's next-100x gate), env-gated:
+        # a FEDERATED fleet — BENCH_K8S_REPLICAS extra controller replicas
+        # join via shard leases (docs/federation.md) and split the shard
+        # space with the primary — drives the soak over the same wire.
+        # Emits the wall clock, per-job status-write cost (the coalescing
+        # evidence next to it), and each replica's pooled queue-latency
+        # p99 from the existing shard metrics.
+        if "error" not in out and os.environ.get("BENCH_K8S_SOAK_10K") == "1":
+            from tf_operator_tpu.runtime.shardlease import ShardLeaseConfig
+
+            n10k = int(os.environ.get("BENCH_K8S_SOAK_10K_JOBS", "10000"))
+            n_replicas = int(os.environ.get("BENCH_K8S_REPLICAS", "3"))
+            shards = int(os.environ.get("BENCH_K8S_SHARDS", "4"))
+            fleet = [controller]
+            # the primary joins the lease protocol too: replace its
+            # all-shards default with a manager (constructed controllers
+            # without one own everything implicitly, which would conflict)
+            from tf_operator_tpu.runtime.shardlease import ShardLeaseManager
+
+            lease_cfg = lambda: ShardLeaseConfig(  # noqa: E731
+                num_shards=shards, lease_duration=10.0, renew_period=2.0)
+            controller.shard_manager = ShardLeaseManager(
+                cluster, "bench-r0", lease_cfg(),
+                on_adopt=controller._on_shard_adopted,
+                on_drop=controller._on_shard_dropped)
+            controller.shard_manager.start()
+            for i in range(1, n_replicas):
+                peer = TPUJobController(
+                    cluster,
+                    config=ReconcilerConfig(
+                        reconciler_sync_loop_period=0.25),
+                    threadiness=4, shards=shards,
+                    shard_lease=lease_cfg(), identity=f"bench-r{i}")
+                peer.start()
+                fleet.append(peer)
+            writes0 = sum(c.status_writer.counters()["writes"]
+                          for c in fleet)
+            coalesced0 = sum(c.status_writer.counters()["coalesced"]
+                             for c in fleet)
+            try:
+                wall, reqs, gets = soak("soak10k-", n10k, 3600)
+                if wall is None:
+                    out["error"] = (
+                        f"10k soak: only "
+                        f"{count_running('soak10k-', n10k)}/{n10k} "
+                        "jobs Running")
+                else:
+                    out["k8s_soak_10000_jobs_sec"] = round(wall, 3)
+                    out["k8s_soak_10k_api_requests_per_job"] = round(
+                        reqs / n10k, 2)
+                    writes = sum(c.status_writer.counters()["writes"]
+                                 for c in fleet) - writes0
+                    coalesced = sum(
+                        c.status_writer.counters()["coalesced"]
+                        for c in fleet) - coalesced0
+                    out["k8s_soak_10k_status_writes_per_job"] = round(
+                        writes / n10k, 2)
+                    out["k8s_soak_10k_status_writes_coalesced"] = coalesced
+                    out["k8s_soak_10k_queue_p99_sec_per_replica"] = [
+                        round(c.work_queue.stats()["latency"]["p99"], 4)
+                        for c in fleet]
+                    out["k8s_soak_10k_replicas"] = n_replicas
+            finally:
+                for peer in fleet[1:]:
+                    peer.stop()
         print(json.dumps(out))
     finally:
         stop.set()

@@ -266,10 +266,26 @@ class FakeApiServer:
                 if not m or not m.group("name"):
                     return self._error(404, f"no route {self.path}")
                 kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
+                body = self._read_body()
+                wanted_rv = (body.get("metadata") or {}).get(
+                    "resourceVersion")
                 with server._lock:
-                    if server._get(kind, ns, name) is None:
+                    obj = server._get(kind, ns, name)
+                    if obj is None:
                         return self._error(404, f"{kind} {ns}/{name} not found")
-                    updated = server._put(kind, ns, name, self._read_body())
+                    if wanted_rv and (obj.get("metadata") or {}).get(
+                            "resourceVersion") != wanted_rv:
+                        # Like the real apiserver: a PUT carrying a stale
+                        # resourceVersion answers 409, it does not clobber.
+                        # The shard-lease acquire protocol DEPENDS on this
+                        # — two racing renews of one expired lease must
+                        # leave exactly one winner, or both replicas claim
+                        # the shard (try_acquire_lease treats the 409 as
+                        # not-acquired).
+                        return self._error(
+                            409, f"{kind} {ns}/{name}: resourceVersion "
+                                 f"conflict")
+                    updated = server._put(kind, ns, name, body)
                 return self._reply(200, updated)
 
             def do_PATCH(self):
@@ -291,15 +307,30 @@ class FakeApiServer:
 
             def do_DELETE(self):
                 server.requests.append(("DELETE", self.path))
+                # ALWAYS drain the body (DeleteOptions): an unread body on
+                # a keep-alive connection desyncs the next request on it.
+                body = self._read_body()
                 if self._faulted("DELETE"):
                     return
                 m = _COLLECTION_RE.match(urlsplit(self.path).path)
                 if not m or not m.group("name"):
                     return self._error(404, f"no route {self.path}")
                 kind, ns, name = m.group("kind"), m.group("ns"), m.group("name")
+                wanted_rv = (body.get("preconditions") or {}).get(
+                    "resourceVersion")
                 with server._lock:
-                    if server._get(kind, ns, name) is None:
+                    obj = server._get(kind, ns, name)
+                    if obj is None:
                         return self._error(404, f"{kind} {ns}/{name} not found")
+                    if wanted_rv and (obj.get("metadata") or {}).get(
+                            "resourceVersion") != wanted_rv:
+                        # DeleteOptions.preconditions, like the real
+                        # apiserver: a stale rv means someone re-wrote the
+                        # object since the caller read it (lease handoff
+                        # races rely on this answering 409, not deleting)
+                        return self._error(
+                            409, f"{kind} {ns}/{name}: resourceVersion "
+                                 f"precondition failed")
                     server._delete(kind, ns, name)
                 return self._reply(200, {"kind": "Status", "code": 200})
 

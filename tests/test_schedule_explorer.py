@@ -36,10 +36,15 @@ from tf_operator_tpu.controller.health import (
     SelfHealingConfig,
     SyncHealth,
 )
-from tf_operator_tpu.runtime.cluster import EventType, NotFound
+from tf_operator_tpu.runtime.cluster import EventType, InMemoryCluster, NotFound
 from tf_operator_tpu.runtime.informer import InformerCache, _Store
+from tf_operator_tpu.runtime.shardlease import (
+    ShardLeaseConfig,
+    ShardLeaseManager,
+    shard_lease_name,
+)
 from tf_operator_tpu.runtime.workqueue import RateLimitingQueue
-from tf_operator_tpu.utils import locks
+from tf_operator_tpu.utils import clock, locks
 
 FAST_SCHEDULES = 150
 
@@ -514,6 +519,123 @@ class QuarantineScenario(explore.Scenario):
 
 
 # ---------------------------------------------------------------------------
+# 5. shard-lease federation invariants (runtime/shardlease.py)
+
+
+class ShardLeaseScenario(explore.Scenario):
+    """The lease-handoff invariant under adversarial expiry/adoption/
+    rebalance interleavings: three real ShardLeaseManagers over one
+    InMemoryCluster lease store, one of which crashes (stops ticking
+    without releasing) while a clock thread drives its leases toward
+    expiry.  After EVERY tick: no shard is owned (owns()==True) by two
+    managers, and every owned shard's lease-store holder is its owner.
+    After the schedule: the survivors own the whole shard space disjointly
+    and the crashed replica owns nothing — no lost, no doubly-owned key.
+
+    Every step — a manager tick+check, a clock advance — runs under an
+    outer model lock (the QuarantineScenario pattern), so each is one
+    atomic step and the explorer permutes their ORDER: lease expiry lands
+    between any two protocol steps the schedule chooses, which is the
+    granularity lease semantics are defined at (every lease op is
+    store-atomic).  This scenario caught a real bug on first run: tick()
+    used to stamp its local expiry AFTER the acquire call returned, so
+    time passing during the call extended the local claim past the store
+    lease a peer sees expire."""
+
+    name = "shard-lease-handoff"
+    # Each schedule runs 10 model-locked protocol steps with many lock
+    # decisions inside; a smaller tier-1 budget keeps the pin sub-10s
+    # while the ANALYSIS_EXPLORE_BUDGET sweep covers the long tail.
+    fast_schedules = 60
+    SHARDS = 4
+    DURATION = 10.0
+    REPLICAS = ("a", "b", "c")
+    CRASHED = "a"
+
+    def build(self):
+        cluster = InMemoryCluster()
+        managers = {
+            name: ShardLeaseManager(
+                cluster, name,
+                ShardLeaseConfig(num_shards=self.SHARDS,
+                                 lease_duration=self.DURATION))
+            for name in self.REPLICAS
+        }
+        return {"cluster": cluster, "managers": managers,
+                "model": locks.new_lock("model")}
+
+    @classmethod
+    def _check_exclusive(cls, state) -> None:
+        """requires: model lock held (no tick or clock advance can
+        interleave with the reads below)."""
+        managers, cluster = state["managers"], state["cluster"]
+        owned = {name: [s for s in range(cls.SHARDS) if m.owns(s)]
+                 for name, m in managers.items()}
+        claimed = [s for shards in owned.values() for s in shards]
+        assert len(claimed) == len(set(claimed)), (
+            f"doubly-owned shard: {owned}")
+        for name, shards in owned.items():
+            for shard in shards:
+                holder = cluster.lease_holder(shard_lease_name(shard))
+                assert holder == name, (
+                    f"{name} owns shard {shard} but the lease store says "
+                    f"{holder!r} holds it")
+
+    def threads(self, state):
+        managers, model = state["managers"], state["model"]
+
+        def replica(name, ticks):
+            def run():
+                for _ in range(ticks):
+                    with model:
+                        managers[name].tick()
+                        self._check_exclusive(state)
+                    explore.yield_point()
+            return run
+
+        def clk():
+            # +15s total in 2.5s steps: the crashed replica's 10s leases
+            # expire at a schedule-chosen instant, between any two
+            # protocol steps.
+            fake = clock.get()
+            for _ in range(6):
+                with model:
+                    fake.advance(self.DURATION / 4.0)
+                explore.yield_point()
+
+        return [
+            # "a" crashes after 2 ticks: no release, leases age out
+            ("a", replica("a", 2)),
+            ("b", replica("b", 4)),
+            ("c", replica("c", 4)),
+            ("clk", clk),
+        ]
+
+    def check(self, state):
+        managers = state["managers"]
+        # Deterministic settle: whatever the schedule left half-done, the
+        # crashed replica's leases are now past expiry and two survivor
+        # tick rounds rebalance the rest.  (Two rounds: the first can
+        # still see the dead replica's unexpired MEMBERSHIP if the clock
+        # thread was starved, the advance below guarantees the second
+        # sees it gone.)
+        clock.get().advance(self.DURATION + 1.0)
+        survivors = [n for n in self.REPLICAS if n != self.CRASHED]
+        for _ in range(2):
+            for name in survivors:
+                managers[name].tick()
+        owned = {n: set(managers[n].owned_shards()) for n in survivors}
+        union = set().union(*owned.values())
+        assert union == set(range(self.SHARDS)), (
+            f"lost shard(s) after crash handoff: {owned}")
+        assert sum(len(s) for s in owned.values()) == self.SHARDS, (
+            f"doubly-owned shard after handoff: {owned}")
+        crashed = managers[self.CRASHED]
+        assert not any(crashed.owns(s) for s in range(self.SHARDS)), (
+            "crashed replica still claims ownership")
+
+
+# ---------------------------------------------------------------------------
 # drivers
 
 REAL_CODE_SCENARIOS = [
@@ -521,16 +643,17 @@ REAL_CODE_SCENARIOS = [
     InformerCacheScenario,
     QueueScenario,
     QuarantineScenario,
+    ShardLeaseScenario,
 ]
 
 
 @pytest.mark.parametrize("scenario_cls", REAL_CODE_SCENARIOS,
                          ids=lambda c: c.name)
 def test_real_code_scenario_passes_all_schedules(scenario_cls):
-    result = explore.explore(scenario_cls(), schedules=FAST_SCHEDULES,
-                             seed=1)
+    schedules = getattr(scenario_cls, "fast_schedules", FAST_SCHEDULES)
+    result = explore.explore(scenario_cls(), schedules=schedules, seed=1)
     assert result.ok, result.failure.render()
-    assert result.schedules == FAST_SCHEDULES
+    assert result.schedules == schedules
 
 
 @pytest.mark.slow

@@ -22,6 +22,7 @@ into the live/ready verdict `/healthz` serves.
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import threading
 import time
@@ -49,6 +50,7 @@ from ..runtime.reconciler import (
     JobReconciler,
     ReconcilerConfig,
 )
+from ..runtime.shardlease import ShardLeaseConfig, ShardLeaseManager
 from ..runtime.workqueue import ShardedWorkQueue, ShutDown
 from ..utils import clock, locks
 from ..utils import logging as tpulog
@@ -99,6 +101,8 @@ class TPUJobController(JobPlugin):
         shards: int = 1,
         use_informer: bool = True,
         informer_relist_period: float = DEFAULT_RELIST_PERIOD,
+        shard_lease: Optional[ShardLeaseConfig] = None,
+        identity: Optional[str] = None,
     ) -> None:
         self.controller_name = CONTROLLER_NAME
         self.cluster = cluster
@@ -130,8 +134,36 @@ class TPUJobController(JobPlugin):
             reads=self.reads,
         )
         self.expectations = self.reconciler.expectations
+        # All status PUTs (reconcile passes AND the rare out-of-pass Stuck
+        # marker / validation writes) share one coalescing writer so the
+        # no-op/echo suppression sees every write (docs/federation.md).
+        self.status_writer = self.reconciler.status_writer
         self.healing = healing or SelfHealingConfig()
         self.sync_health = SyncHealth(self.healing)
+        # Federation (runtime/shardlease.py, docs/federation.md): with a
+        # ShardLeaseConfig this replica syncs only the shards whose leases
+        # it holds; peers sharing the cluster's lease store split the rest.
+        # The lease shard space IS the workqueue shard space — one
+        # shard_for(key) answers both routing and ownership.
+        self.identity = identity or f"{CONTROLLER_NAME}-{id(self):x}"
+        self.shard_manager: Optional[ShardLeaseManager] = None
+        if shard_lease is not None:
+            # Copy, don't alias: the caller may share one config between
+            # controllers with different shard counts, and mutating theirs
+            # would rewrite a sibling manager's shard range under it.
+            self.shard_manager = ShardLeaseManager(
+                cluster, self.identity,
+                dataclasses.replace(shard_lease, num_shards=self.num_shards),
+                on_adopt=self._on_shard_adopted,
+                on_drop=self._on_shard_dropped,
+            )
+        # Event-driven resync backstop: keys whose last sync verifiably did
+        # nothing (no write, expectations satisfied, no pending timer).
+        # Intermediate resync ticks skip them; any watch event or shard
+        # adoption clears the mark (docs/federation.md).
+        self._quiescent: set = set()  # guarded-by: _quiescent_lock
+        self._quiescent_lock = locks.new_lock("controller-quiescent")
+        self._resync_tick = 0  # only the resync thread touches it
         self._stop = threading.Event()
         self._resync_now = threading.Event()  # watchdog-triggered resync
         self._started = False
@@ -159,8 +191,125 @@ class TPUJobController(JobPlugin):
     # watch handlers (ref: controller.go:135-175; job.go:54-170;
     # common/pod.go:73-214)
 
+    # ------------------------------------------------------------------
+    # shard ownership + quiescence (the federation seams; no-ops without a
+    # shard manager — the solo controller behaves exactly as before)
+
+    def owns_key(self, key: str) -> bool:
+        """Does this replica currently own `key`'s shard lease?  Always
+        True without federation."""
+        return (self.shard_manager is None
+                or self.shard_manager.owns(self.work_queue.shard_index(key)))
+
+    def _enqueue(self, key: str) -> None:
+        """Ownership-gated enqueue: every peer replica sees every watch
+        event, but only the shard owner queues work for it.  Keys of
+        unowned shards are dropped here — the owner saw the same event."""
+        if self.owns_key(key):
+            self.work_queue.add(key)
+
+    def _mark_active(self, key: str) -> None:
+        with self._quiescent_lock:
+            self._quiescent.discard(key)
+
+    def _is_quiescent(self, key: str) -> bool:
+        with self._quiescent_lock:
+            return key in self._quiescent
+
+    def _note_pass(self, key: str, job: TPUJob, result) -> None:
+        """After a reconcile pass: a verified no-op (nothing written, no
+        creations/deletions pending, no timer to re-arm, not a dynamic-
+        worker job that syncs every loop) marks the key quiescent so the
+        resync backstop skips it until the next event touches it."""
+        quiet = (not result.wrote_status
+                 and result.requeue_after is None
+                 and not job.spec.enable_dynamic_worker
+                 and self.satisfied_expectations(job))
+        with self._quiescent_lock:
+            if quiet:
+                self._quiescent.add(key)
+            else:
+                self._quiescent.discard(key)
+
+    def _forget_key(self, key: str) -> None:
+        """Release every per-key residue on deletion/NotFound."""
+        self.expectations.delete_expectations(key)
+        self.work_queue.forget(key)
+        self.sync_health.forget(key)
+        self.status_writer.forget(key)
+        with self._quiescent_lock:
+            self._quiescent.discard(key)
+
+    def _on_shard_adopted(self, shard: int) -> None:
+        """We just acquired `shard`'s lease (initial claim, rebalance, or a
+        dead peer's expiry).  Replay every job on the shard: whatever
+        events fired while the shard was ownerless are repaired here,
+        which is the no-lost-key half of the handoff invariant.  A job
+        with NO conditions was created in an ownerless window and never
+        admitted anywhere — it gets the full add_job admission (validate,
+        reject-or-stamp-Created, enqueue), not a bare enqueue: the sync
+        path never validates, so skipping admission would reconcile an
+        invalid spec into quarantine instead of FailedValidation."""
+        try:
+            if self.informer is not None:
+                keys = self.informer.job_keys()
+            else:
+                keys = [job.key() for job in self.reads.list_jobs()]
+            for key in keys:
+                if self.work_queue.shard_index(key) != shard:
+                    continue
+                self._mark_active(key)
+                namespace, _, name = key.partition("/")
+                try:
+                    job = self.reads.get_job(namespace, name)
+                except NotFound:
+                    continue  # deleted since the key scan
+                if not job.status.conditions:
+                    # Admit a PRIVATE copy (the sync path's deepcopy
+                    # idiom): `job` may be the informer's live cached
+                    # object, and add_job mutates (defaults + Created
+                    # stamp) — mutating the cache in place diverges it
+                    # from the wire until a relist quietly reverts it.
+                    job = job.deepcopy()
+                    self.add_job(job)
+                    if any(c.type == JobConditionType.CREATED
+                           for c in job.status.conditions):
+                        # Persist the admission verdict: nothing else
+                        # writes the Created stamp for a job admitted
+                        # here (the validation-reject path writes its
+                        # own Failed status inside add_job).
+                        try:
+                            self.status_writer.write(
+                                job.metadata.namespace,
+                                job.metadata.name, job.status)
+                        except NotFound:
+                            pass
+                else:
+                    self.work_queue.add(key)
+        except Exception as err:  # noqa: BLE001 — next resync tick re-covers the shard
+            tpulog.logger_for_key("shardlease").warning(
+                "adoption enqueue of shard %d failed: %s", shard, err)
+
+    def _on_shard_dropped(self, shard: int) -> None:
+        """We no longer own `shard`: drop its queued/delayed keys (the new
+        owner re-enqueues on adoption) and forget our last-written status
+        snapshots — a peer may write those keys now, so our memory of the
+        wire is no longer trustworthy."""
+        self.work_queue.purge_shard(shard)
+        self.status_writer.forget_where(
+            lambda key: self.work_queue.shard_index(key) == shard)
+
+    # ------------------------------------------------------------------
+
     def _on_job_event(self, etype: EventType, job: TPUJob) -> None:
         if etype == EventType.ADDED:
+            if not self.owns_key(job.key()):
+                # A peer owns this shard; its add_job runs admission.  If
+                # the shard is ownerless right now, whoever adopts it
+                # re-enqueues the key and the sync path takes over (the
+                # same catch-up an operator restart gets).
+                return
+            self._mark_active(job.key())
             self.add_job(job)
         elif etype == EventType.MODIFIED:
             # Fingerprints are only computed for quarantined keys: the
@@ -177,13 +326,12 @@ class TPUJobController(JobPlugin):
                 self.work_queue.forget(job.key())
                 tpulog.logger_for_key(job.key()).info(
                     "spec change released quarantine")
-            self.work_queue.add(job.key())
+            self._mark_active(job.key())
+            self._enqueue(job.key())
         elif etype == EventType.DELETED:
             # Pods/services are garbage-collected by ownership in real k8s;
             # our substrates clean up on terminal state instead.
-            self.expectations.delete_expectations(job.key())
-            self.work_queue.forget(job.key())
-            self.sync_health.forget(job.key())
+            self._forget_key(job.key())
             with self._warned_lock:
                 self._multislice_warned.discard(job.key())
 
@@ -209,7 +357,7 @@ class TPUJobController(JobPlugin):
                 )
             )
             try:
-                self.cluster.update_job_status(
+                self.status_writer.write(
                     job.metadata.namespace, job.metadata.name, job.status
                 )
             except NotFound:
@@ -224,7 +372,7 @@ class TPUJobController(JobPlugin):
             f"TPUJob {job.metadata.name} is created.",
         )
         metrics.jobs_created.labels().inc()
-        self.work_queue.add(job.key())
+        self._enqueue(job.key())
 
     def _on_pod_event(self, etype: EventType, pod: Pod) -> None:
         key = self._owner_key(pod)
@@ -235,7 +383,8 @@ class TPUJobController(JobPlugin):
             self.expectations.creation_observed(expectation_key(key, rtype, "pods"))
         elif etype == EventType.DELETED:
             self.expectations.deletion_observed(expectation_key(key, rtype, "pods"))
-        self.work_queue.add(key)
+        self._mark_active(key)
+        self._enqueue(key)
 
     def _on_service_event(self, etype: EventType, svc: Service) -> None:
         key = self._owner_key(svc)
@@ -246,7 +395,8 @@ class TPUJobController(JobPlugin):
             self.expectations.creation_observed(expectation_key(key, rtype, "services"))
         elif etype == EventType.DELETED:
             self.expectations.deletion_observed(expectation_key(key, rtype, "services"))
-        self.work_queue.add(key)
+        self._mark_active(key)
+        self._enqueue(key)
 
     @staticmethod
     def _owner_key(obj) -> Optional[str]:
@@ -273,6 +423,11 @@ class TPUJobController(JobPlugin):
         self._started = True
         if self.informer is not None:
             self.informer.start_relist()
+        if self.shard_manager is not None:
+            # Synchronous first tick inside: this replica owns (and has
+            # enqueued, via _on_shard_adopted) its share of the shard space
+            # before the first worker pops a key.
+            self.shard_manager.start()
         for i in range(self.total_workers):
             self._spawn_worker(i)
         resync = threading.Thread(target=self._resync_loop, name="tpujob-resync", daemon=True)
@@ -320,8 +475,16 @@ class TPUJobController(JobPlugin):
             # Wake early when the watchdog requests a triggered resync
             # (stale-watch kick): the relist must NOT run on the watchdog
             # thread, where a hung apiserver would block hang detection.
-            self._resync_now.wait(timeout=self.resync_period_current)
-            self._resync_now.clear()
+            triggered = self._resync_now.wait(
+                timeout=self.resync_period_current)
+            if triggered:
+                # Clear ONLY when the flag was observed: a watchdog set()
+                # landing between a timed-out wait and an unconditional
+                # clear() would be swallowed — and with the event-driven
+                # backstop, a swallowed trigger downgrades the stale-watch
+                # repair to a quiescent-skipping tick.  Left set, the next
+                # wait() returns immediately and runs the full tick.
+                self._resync_now.clear()
             if self._stop.is_set():
                 break
             # Whole tick under one guard: the resync thread must never die —
@@ -335,12 +498,25 @@ class TPUJobController(JobPlugin):
                 # the tick's enqueue below delivers it to a worker, which
                 # admits exactly one sync attempt (controller/health.py).
                 self.sync_health.grant_probes()
+                # Event-driven backstop: most ticks skip quiescent keys —
+                # jobs whose last sync verifiably did nothing and which
+                # hold no pending timer — so the steady-state cost of an
+                # idle job is zero syncs per tick.  Every Nth tick (and
+                # every watchdog-triggered one: those exist to repair lost
+                # events, which is exactly what quiescence cannot see)
+                # enqueues everything.
+                self._resync_tick += 1
+                every = self.healing.full_resync_every
+                full = (triggered or every <= 1
+                        or self._resync_tick % every == 0)
                 # The relist comes from the informer store when one runs:
                 # at 5k jobs a per-tick wire LIST is exactly the traffic
                 # the cache exists to collapse, and the informer's own
                 # relist loop keeps the store honest on its own cadence.
                 for job in self.reads.list_jobs():
-                    self.work_queue.add(job.key())
+                    key = job.key()
+                    if full or not self._is_quiescent(key):
+                        self._enqueue(key)
             except Exception as err:  # noqa: BLE001 — transient; next tick retries
                 tpulog.logger_for_key("resync").warning(
                     "resync tick failed: %s", err)
@@ -389,6 +565,12 @@ class TPUJobController(JobPlugin):
     def stop(self) -> None:
         self._stop.set()
         self._resync_now.set()  # wake the resync loop out of its period wait
+        if self.shard_manager is not None:
+            # Graceful handoff: release our shard leases so survivors adopt
+            # immediately instead of waiting out the lease duration.  (A
+            # crash-stopped manager — stop(release=False) already called —
+            # keeps crash semantics; this second stop is a no-op.)
+            self.shard_manager.stop(release=True)
         if self.informer is not None:
             self.informer.stop()
         self.work_queue.shutdown()
@@ -398,13 +580,23 @@ class TPUJobController(JobPlugin):
             t.join(timeout=5)
 
     def _run_worker(self, worker_id: int) -> None:
-        shard_queue = self.work_queue.shard(self.shard_of_worker(worker_id))
+        shard = self.shard_of_worker(worker_id)
+        shard_queue = self.work_queue.shard(shard)
         while not self._stop.is_set():
             try:
                 key = shard_queue.get(timeout=0.5)
             except ShutDown:
                 return
             except TimeoutError:
+                continue
+            if (self.shard_manager is not None
+                    and not self.shard_manager.owns(shard)):
+                # Ownership fence at the last possible moment: the lease
+                # was lost (or never re-acquired) between enqueue and pop.
+                # Absorb the key — the current owner re-enqueued the whole
+                # shard on adoption, so nothing is lost, and syncing here
+                # would be the doubly-owned split brain the leases prevent.
+                shard_queue.done(key)
                 continue
             try:
                 if not self.sync_health.admit(key):
@@ -431,6 +623,9 @@ class TPUJobController(JobPlugin):
                 if synced and self.sync_health.record_sync_success(key):
                     self._clear_stuck_condition(key)
             except Exception as err:  # noqa: BLE001 — sync errors requeue with backoff
+                # A failing key is never quiescent: the resync backstop
+                # must keep seeing it even if an older pass marked it idle.
+                self._mark_active(key)
                 action = self.sync_health.record_sync_failure(key, str(err))
                 tpulog.logger_for_key(key).warning("sync failed: %s", err)
                 if action == ACTION_REQUEUE:
@@ -471,11 +666,10 @@ class TPUJobController(JobPlugin):
             job = self.reads.get_job(namespace, name)
         except NotFound:
             # The job is gone: release every per-key residue — expectations,
-            # rate-limiter backoff state, and any quarantine — or the maps
-            # grow one dead entry per deleted job for the process lifetime.
-            self.expectations.delete_expectations(key)
-            self.work_queue.forget(key)
-            self.sync_health.forget(key)
+            # rate-limiter backoff state, status-writer snapshot, and any
+            # quarantine — or the maps grow one dead entry per deleted job
+            # for the process lifetime.
+            self._forget_key(key)
             return True
 
         job = job.deepcopy()
@@ -489,6 +683,7 @@ class TPUJobController(JobPlugin):
         result = self.reconciler.reconcile_job(job)
         if result.requeue_after is not None:
             self.work_queue.add_after(key, result.requeue_after)
+        self._note_pass(key, job, result)
         return True
 
     def satisfied_expectations(self, job: TPUJob) -> bool:
@@ -540,7 +735,7 @@ class TPUJobController(JobPlugin):
             # exactly what is quarantining.
             conditions.set_operational_condition(
                 job.status, JobConditionType.STUCK, JOB_STUCK_REASON, message)
-            self.cluster.update_job_status(namespace, name, job.status)
+            self.status_writer.write(namespace, name, job.status)
         except NotFound:
             self.sync_health.forget(key)
         except Exception as err:  # noqa: BLE001 — marker is best-effort
@@ -559,7 +754,7 @@ class TPUJobController(JobPlugin):
             if conditions.clear_condition(
                     job.status, JobConditionType.STUCK, JOB_RECOVERED_REASON,
                     "sync succeeded; quarantine released"):
-                self.cluster.update_job_status(namespace, name, job.status)
+                self.status_writer.write(namespace, name, job.status)
         except NotFound:
             pass
         except Exception as err:  # noqa: BLE001 — marker is best-effort
@@ -741,6 +936,9 @@ class TPUJobController(JobPlugin):
                           num_shards=self.num_shards),
             "informer": (self.informer.report()
                          if self.informer is not None else None),
+            "federation": (self.shard_manager.report()
+                           if self.shard_manager is not None else None),
+            "status_writer": self.status_writer.counters(),
             "syncs": {
                 "in_flight_stuck": stuck,
                 "stuck_sync_deadline_seconds": self.healing.stuck_sync_deadline,

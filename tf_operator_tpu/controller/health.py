@@ -57,6 +57,12 @@ class SelfHealingConfig:
     watchdog_interval: float = 1.0
     # bound on the per-key last-sync-error detail map
     sync_errors_cap: int = 64
+    # Event-driven resync backstop cadence: every Nth tick enqueues ALL
+    # jobs; the ticks in between skip keys whose last sync was a verified
+    # no-op (quiescent), so an idle job costs zero syncs and zero writes
+    # per backstop tick.  1 restores the classic enqueue-everything tick;
+    # watchdog-triggered resyncs (stale-watch repair) are always full.
+    full_resync_every: int = 4
 
 
 @dataclass

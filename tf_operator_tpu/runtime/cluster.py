@@ -123,8 +123,19 @@ class ClusterInterface:
     def watch_pods(self, handler: WatchHandler) -> None: ...
     def watch_services(self, handler: WatchHandler) -> None: ...
 
-    # leases (leader election)
+    # leases (leader election + shard-lease federation, runtime/shardlease.py)
     def try_acquire_lease(self, name: str, holder: str, ttl: float) -> bool: ...
+
+    def release_lease(self, name: str, holder: str) -> bool:
+        """Voluntarily give up `name` if (and only if) `holder` holds it —
+        the graceful half of shard handoff; expiry covers crashes.  Returns
+        True when a lease was actually released."""
+        ...
+
+    def list_leases(self, prefix: str = "") -> Dict[str, str]:
+        """Unexpired leases whose name starts with `prefix`, as
+        {name: holder} — the shard-lease membership read."""
+        ...
 
 
 def _matches(labels: Dict[str, str], selector: Optional[Dict[str, str]]) -> bool:
@@ -459,6 +470,25 @@ class InMemoryCluster(ClusterInterface):
             if current is None or current[1] < clock.now():
                 return None
             return current[0]
+
+    def release_lease(self, name: str, holder: str) -> bool:
+        """Delete `name` iff `holder` holds it (expired or not): the
+        holder-check keeps a slow ex-owner's late release from deleting a
+        lease a successor already re-acquired."""
+        with self._lock:
+            current = self._leases.get(name)
+            if current is not None and current[0] == holder:
+                del self._leases[name]
+                return True
+            return False
+
+    def list_leases(self, prefix: str = "") -> Dict[str, str]:
+        now = clock.now()
+        with self._lock:
+            return {
+                n: h for n, (h, expiry) in self._leases.items()
+                if n.startswith(prefix) and expiry >= now
+            }
 
     # --- test helpers (the SetPodsStatuses analogue, testutil/pod.go:67-95) ---
 

@@ -225,6 +225,13 @@ class _Store:
         with self._lock:
             return self._objects.get(f"{namespace}/{name}")
 
+    def keys(self) -> List[str]:
+        """Every stored "ns/name" key, without materializing objects —
+        the shard-adoption scan (controller._on_shard_adopted) only needs
+        keys to route through shard_for()."""
+        with self._lock:
+            return list(self._objects)
+
     def list(self, namespace: Optional[str] = None,
              selector: Optional[Dict[str, str]] = None) -> List[Any]:
         with self._lock:
@@ -424,6 +431,11 @@ class InformerCache:
         # same moment the controller would learn about it anyway.
         self._count("jobs", hit=False)
         return self.cluster.get_job(namespace, name)
+
+    def job_keys(self) -> List[str]:
+        """All cached job keys ("ns/name") — the cheap shard-adoption scan."""
+        self._count("jobs", hit=True)
+        return self.jobs.keys()
 
     def list_jobs(self, namespace: Optional[str] = None) -> List[Any]:
         self._count("jobs", hit=True)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import datetime as _dt
 import json
+import math
 import os
 import random
 import ssl
@@ -90,6 +91,15 @@ def to_rfc3339(ts: Optional[float]) -> Optional[str]:
     )
 
 
+def to_rfc3339_micro(ts: float) -> str:
+    """k8s MicroTime shape ('...T12:00:00.123456Z') — lease renew stamps,
+    where flooring to whole seconds would eat the shard-lease ownership
+    margin (lease_renew_time round-trips the fraction)."""
+    return _dt.datetime.fromtimestamp(ts, _dt.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%fZ"
+    )
+
+
 def from_rfc3339(text: Optional[str]) -> Optional[float]:
     if not text:
         return None
@@ -105,6 +115,31 @@ def from_rfc3339(text: Optional[str]) -> Optional[float]:
 
 def quantity_to_str(value: float) -> str:
     return str(int(value)) if float(value).is_integer() else str(value)
+
+
+def lease_renew_time(spec: dict) -> Optional[float]:
+    """Parse a coordination.k8s.io Lease spec's renewTime, tolerating both
+    fractional ('...T12:00:00.123456Z' — what our writer stamps, k8s
+    MicroTime) and fraction-less ('...T12:00:00Z' — what other clients may
+    write) timestamps.  The ONE parse both try_acquire_lease and
+    list_leases use.  The fraction is KEPT, not floored: the shard-lease
+    ownership margin (runtime/shardlease.py) assumes peers compute expiry
+    from the instant the holder actually stamped — flooring here would
+    make peers see expiry up to 1s early and hand back most of the margin.
+    (A naive split('.')[0]+'Z' also turns the fraction-less form into a
+    double-Z string that parses to None, silently treating a live peer's
+    lease as expired.)"""
+    raw = (spec.get("renewTime") or "").rstrip("Z")
+    if not raw:
+        return None
+    base, _, frac = raw.partition(".")
+    ts = from_rfc3339(base + "Z")
+    if ts is None or not frac:
+        return ts
+    try:
+        return ts + float("0." + frac)
+    except ValueError:
+        return ts
 
 
 def quantity_to_float(text: Any) -> float:
@@ -1696,9 +1731,18 @@ class KubernetesCluster(ClusterInterface):
                 "metadata": {"name": name, "namespace": namespace},
                 "spec": {
                     "holderIdentity": holder,
-                    "leaseDurationSeconds": int(ttl),
-                    "renewTime": to_rfc3339(now).replace("Z", ".000000Z"),
-                    "acquireTime": to_rfc3339(now).replace("Z", ".000000Z"),
+                    # ceil, not int: the API field is integral, and a
+                    # truncated fractional ttl would make peers compute
+                    # expiry EARLIER than the holder's local float claim —
+                    # eating into the shard-lease ownership margin.
+                    # Rounding up only delays adoption, the safe direction.
+                    "leaseDurationSeconds": math.ceil(ttl),
+                    # Real microseconds (k8s MicroTime), not a floored
+                    # stamp with a fake .000000: lease_renew_time keeps
+                    # the fraction, so peers reconstruct this exact
+                    # instant and the ownership margin stays whole.
+                    "renewTime": to_rfc3339_micro(now),
+                    "acquireTime": to_rfc3339_micro(now),
                 },
             }
 
@@ -1724,7 +1768,7 @@ class KubernetesCluster(ClusterInterface):
             return False
         spec = raw.get("spec") or {}
         current_holder = spec.get("holderIdentity", "")
-        renew = from_rfc3339((spec.get("renewTime") or "").split(".")[0] + "Z")
+        renew = lease_renew_time(spec)
         duration = float(spec.get("leaseDurationSeconds") or ttl)
         expired = renew is None or (clock.now() - renew) > duration
         if current_holder and current_holder != holder and not expired:
@@ -1743,6 +1787,67 @@ class KubernetesCluster(ClusterInterface):
             # throttled past the retry budget, or transport trouble: treat
             # as not-acquired and let the elector loop retry.
             return False
+
+    def release_lease(self, name: str, holder: str) -> bool:
+        """Voluntary lease handoff (runtime/shardlease.py): DELETE the Lease
+        iff `holder` still holds it.  Best-effort by design — every failure
+        mode (conflict, transport, already gone) returns False and expiry
+        remains the backstop, exactly like a crashed holder."""
+        namespace = self._ns(None)
+        path = (f"/apis/coordination.k8s.io/v1/namespaces/{namespace}"
+                f"/leases/{name}")
+        deadline = 5.0  # short, like the lease acquire path: never wedge a handoff
+        try:
+            raw = self.client.request("GET", path, deadline=deadline)
+        except (NotFound, ApiError, TooManyRequests, OSError, HTTPException):
+            return False
+        if ((raw.get("spec") or {}).get("holderIdentity", "")) != holder:
+            return False  # a successor already re-acquired: leave it alone
+        try:
+            # resourceVersion precondition: between the GET above and this
+            # DELETE a successor may have re-acquired the (expired) lease
+            # via PUT — an unconditional DELETE would then remove ITS
+            # fresh lease while it still answers owns()=True locally.  A
+            # conflict means exactly that; report not-released.
+            self.client.request(
+                "DELETE", path,
+                body={
+                    "kind": "DeleteOptions", "apiVersion": "v1",
+                    "preconditions": {
+                        "resourceVersion": (raw.get("metadata") or {}).get(
+                            "resourceVersion", ""),
+                    },
+                },
+                deadline=deadline)
+            return True
+        except (NotFound, AlreadyExists, ApiError, TooManyRequests,
+                OSError, HTTPException):
+            # AlreadyExists is what a 409 — the precondition conflict this
+            # DELETE exists to detect — surfaces as.
+            return False
+
+    def list_leases(self, prefix: str = "") -> Dict[str, str]:
+        """Unexpired {name: holder} with a name prefix filter (client-side;
+        the shard-lease membership read).  Expiry follows the same
+        renewTime+duration rule try_acquire_lease applies."""
+        namespace = self._ns(None)
+        path = f"/apis/coordination.k8s.io/v1/namespaces/{namespace}/leases"
+        raw = self.client.request("GET", path, deadline=5.0)
+        out: Dict[str, str] = {}
+        for item in raw.get("items") or []:
+            name = (item.get("metadata") or {}).get("name", "")
+            if not name.startswith(prefix):
+                continue
+            spec = item.get("spec") or {}
+            holder = spec.get("holderIdentity", "")
+            if not holder:
+                continue
+            renew = lease_renew_time(spec)
+            duration = float(spec.get("leaseDurationSeconds") or 0)
+            if renew is None or (clock.now() - renew) > duration:
+                continue
+            out[name] = holder
+        return out
 
     def close(self) -> None:
         self._stop.set()

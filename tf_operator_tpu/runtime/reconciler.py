@@ -49,6 +49,7 @@ from . import conditions
 from .cluster import AlreadyExists, ClusterInterface, NotFound
 from .control import PodControlInterface, ServiceControlInterface
 from .expectations import Expectations, expectation_key
+from .statuswriter import CoalescingStatusWriter, snapshot_status
 
 
 class JobPlugin:
@@ -118,6 +119,10 @@ class ReconcileResult:
     terminal: bool = False
     failed_reason: str = ""
     requeue_after: Optional[float] = None
+    # did this pass PUT a status to the wire?  The controller's quiescence
+    # tracker uses this: a pass that wrote nothing AND left expectations
+    # satisfied is an idle job the event-driven resync backstop may skip.
+    wrote_status: bool = False
 
 
 def gen_labels(job_name: str) -> Dict[str, str]:
@@ -229,6 +234,7 @@ class JobReconciler:
         plugin: JobPlugin,
         config: Optional[ReconcilerConfig] = None,
         reads: Optional[Any] = None,
+        status_writer: Optional[CoalescingStatusWriter] = None,
     ) -> None:
         self.cluster = cluster
         self.pod_control = pod_control
@@ -236,6 +242,11 @@ class JobReconciler:
         self.plugin = plugin
         self.config = config or ReconcilerConfig()
         self.expectations = Expectations()
+        # Every status PUT goes through the coalescing writer
+        # (runtime/statuswriter.py): no-op suppression, per-pass transition
+        # merging, stale-informer-read echo suppression.  Shared with the
+        # controller so its Stuck-marker writes keep the same bookkeeping.
+        self.status_writer = status_writer or CoalescingStatusWriter(cluster)
         # The read path: an informer cache (runtime/informer.py) when the
         # controller runs one, else the cluster itself.  Only the list verbs
         # the per-sync hot path issues go through it; every write — and the
@@ -310,7 +321,7 @@ class JobReconciler:
                     rs.succeeded += rs.active
                     rs.active = 0
             result.terminal = True
-            self._write_status_if_changed(job, old_status)
+            result.wrote_status = self._write_status_if_changed(job, old_status)
             return result
 
         # Job-level limits (ref: job.go:159-214).
@@ -345,7 +356,7 @@ class JobReconciler:
             metrics.jobs_failed.labels().inc()
             result.terminal = True
             result.failed_reason = failure_reason
-            self._write_status_if_changed(job, old_status)
+            result.wrote_status = self._write_status_if_changed(job, old_status)
             return result
 
         # Gang scheduling: ensure the PodGroup exists before any pod
@@ -368,7 +379,7 @@ class JobReconciler:
         self.plugin.update_job_status(
             job, replicas, job.status, pods, restarting_this_pass
         )
-        self._write_status_if_changed(job, old_status)
+        result.wrote_status = self._write_status_if_changed(job, old_status)
         # ActiveDeadlineSeconds enforcement: re-arm the wakeup on EVERY
         # pass, not only when start_time is first set (the plugin hook,
         # ref: status.go:78-86).  The workqueue coalesces delayed
@@ -843,12 +854,12 @@ class JobReconciler:
 
     # ------------------------------------------------------------------
 
-    def _write_status_if_changed(self, job: TPUJob, old_status_snapshot) -> None:
-        """DeepEqual status-write guard (ref: job.go:248-250, status.go:207-225)."""
-        if _snapshot_status(job.status) != old_status_snapshot:
-            self.cluster.update_job_status(
-                job.metadata.namespace, job.metadata.name, job.status
-            )
+    def _write_status_if_changed(self, job: TPUJob, old_status_snapshot) -> bool:
+        """DeepEqual status-write guard (ref: job.go:248-250, status.go:207-225),
+        now served by the coalescing writer — which also merges multi-
+        transition passes into one PUT and suppresses stale-informer-read
+        echoes of our own last write.  Returns True when a PUT went out."""
+        return self.status_writer.write_if_changed(job, old_status_snapshot)
 
 
 def _set_restart_policy(pod: Pod, rspec: ReplicaSpec) -> None:
@@ -867,19 +878,6 @@ def _replica_type_from_label(raw: str) -> Optional[ReplicaType]:
     return None
 
 
-def _snapshot_status(status: JobStatus):
-    """Hashable deep snapshot for the DeepEqual guard (times that only tick,
-    like last_reconcile_time, are excluded)."""
-    return (
-        tuple(
-            (c.type, c.status, c.reason, c.message) for c in status.conditions
-        ),
-        tuple(
-            sorted(
-                (k, v.active, v.succeeded, v.failed)
-                for k, v in status.replica_statuses.items()
-            )
-        ),
-        status.start_time,
-        status.completion_time,
-    )
+# Canonical impl moved to runtime/statuswriter.py (the coalescing writer
+# compares the same snapshots); kept importable under the old name.
+_snapshot_status = snapshot_status

@@ -1,0 +1,348 @@
+"""Lease-based shard ownership: the federation layer (docs/federation.md).
+
+PR 6 sharded the workqueue so one process could reconcile 1,000+ TPUJobs;
+the next 100× cannot come from one Python process ("Exploring the limits of
+Concurrency in ML Training on Google TPUs", PAPERS.md).  This module
+generalizes the 1-owns-all leader election (`server.LeaderElector` over
+`ClusterInterface.try_acquire_lease`) into **per-shard leases**: N controller
+replicas split the `shard_for(key, num_shards)` space, each replica syncs
+only the shards whose leases it holds, and replica death hands the orphaned
+shards to survivors with no lost and no doubly-owned key.
+
+Protocol (all state lives in the cluster's lease store, none is exchanged
+replica-to-replica):
+
+  - **Membership.**  Each replica heartbeats one lease named
+    `tpu-operator-replica-<identity>` every `renew_period`.  The live
+    member set is the holders of unexpired replica leases — a crashed
+    replica simply stops renewing and ages out after `lease_duration`.
+  - **Deterministic assignment.**  Shard `i`'s desired owner is
+    `sorted(members)[i % len(members)]`.  Every replica computes the same
+    assignment from the same lease store, so rebalancing needs no
+    coordinator: when membership changes, each replica independently
+    acquires the shards newly assigned to it and releases the ones that
+    are not.
+  - **Ownership = an unexpired shard lease.**  A replica acquires/renews
+    `tpu-operator-shard-<i>` only while it is the desired owner.
+    `owns(i)` answers True only inside the lease it last renewed, MINUS
+    `ownership_margin` — so a replica stops claiming a shard strictly
+    before the lease can expire under anyone else, and two replicas can
+    never both answer True for one shard (the no-doubly-owned half of the
+    invariant; `tests/test_schedule_explorer.py` pins it under adversarial
+    interleavings).
+  - **Handoff.**  Voluntary (rebalance/shutdown): drop from the owned set
+    FIRST, then release the lease — the new owner can only acquire after
+    we stopped claiming.  Involuntary (crash): the lease expires and the
+    new desired owner's next tick acquires it.  Either way the adopter's
+    `on_adopt` callback re-enqueues every key of the shard, which is the
+    no-lost-key half of the invariant.
+
+Timing uses `clock.now()` throughout (never wall time directly) so the
+interleaving explorer can drive lease expiry deterministically under a
+FakeClock, exactly as the in-memory lease store does.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from ..utils import clock, locks
+from ..utils import logging as tpulog
+from ..utils import metrics
+
+log = tpulog.logger_for_key("shardlease")
+
+SHARD_LEASE_PREFIX = "tpu-operator-shard-"
+REPLICA_LEASE_PREFIX = "tpu-operator-replica-"
+
+
+@dataclass
+class ShardLeaseConfig:
+    """Tuning knobs for shard-lease federation (server --shard-lease-*)."""
+
+    # shard count — MUST equal the controller's workqueue shard count so
+    # lease ownership and queue routing agree on shard_for(key)
+    num_shards: int = 1
+    # seconds a shard/replica lease lives without renewal; crash-failover
+    # latency is bounded by this
+    lease_duration: float = 15.0
+    # seconds between renew/rebalance ticks; must be well under
+    # lease_duration or a healthy replica loses its own shards
+    renew_period: float = 5.0
+    # owns() answers False this many seconds BEFORE the lease expires, so a
+    # late renewal can never overlap a peer's expiry-based adoption.
+    # Clamped to a quarter of lease_duration so short (test/chaos) leases
+    # keep a usable ownership window.
+    ownership_margin: float = 1.0
+
+    def effective_margin(self) -> float:
+        return min(self.ownership_margin, self.lease_duration / 4.0)
+
+
+def shard_lease_name(shard: int) -> str:
+    return f"{SHARD_LEASE_PREFIX}{shard}"
+
+
+class ShardLeaseManager:
+    """One replica's view of the shard-lease protocol above.
+
+    `tick()` is the whole protocol — heartbeat membership, compute the
+    deterministic assignment, acquire/renew desired shards, drop the rest —
+    and is safe to call directly (the explorer scenarios do); `start()`
+    runs it on a `tpujob-shardlease` thread every `renew_period`.
+    `on_adopt(shard)` / `on_drop(shard)` fire outside every internal lock,
+    after the owned set already reflects the change."""
+
+    def __init__(
+        self,
+        cluster,
+        identity: str,
+        config: Optional[ShardLeaseConfig] = None,
+        on_adopt: Optional[Callable[[int], None]] = None,
+        on_drop: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        self.cluster = cluster
+        self.identity = identity
+        self.config = config or ShardLeaseConfig()
+        self.on_adopt = on_adopt
+        self.on_drop = on_drop
+        self._lock = locks.new_lock("shard-lease")
+        # shard -> expiry (clock.now() domain) of OUR last successful renew
+        self._owned: Dict[int, float] = {}  # guarded-by: _lock
+        self._adoptions = 0  # guarded-by: _lock
+        self._drops = 0  # guarded-by: _lock
+        # member list as of the last tick, for report(): /healthz must not
+        # pay (or hang on) a wire LIST of leases per poll
+        self._members_cache: List[str] = [identity]  # guarded-by: _lock
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+
+    # ------------------------------------------------------------------
+    # membership + assignment
+
+    def members(self) -> List[str]:
+        """Sorted live replica identities (unexpired replica leases), always
+        including self.  A substrate without list_leases federates as a
+        fleet of one — every shard is ours, the solo-controller behavior."""
+        holders = {self.identity}
+        list_leases = getattr(self.cluster, "list_leases", None)
+        if list_leases is not None:
+            try:
+                # `or {}`: a substrate inheriting ClusterInterface's bare
+                # `...` stub returns None — treat that like the method
+                # being absent (fleet of one), not as an error to log
+                # every renew tick.
+                leases = list_leases(prefix=REPLICA_LEASE_PREFIX) or {}
+                holders.update(leases.values())
+            except Exception as err:  # noqa: BLE001 — stale view beats a dead tick
+                log.warning("listing replica leases failed: %s", err)
+        return sorted(holders)
+
+    @staticmethod
+    def desired_owner(shard: int, members: List[str]) -> str:
+        """The deterministic assignment every replica computes identically:
+        round-robin over the sorted member list."""
+        return members[shard % len(members)]
+
+    # ------------------------------------------------------------------
+    # the protocol tick
+
+    def tick(self) -> None:
+        """One renew/rebalance pass (see module docstring)."""
+        cfg = self.config
+        try:
+            self.cluster.try_acquire_lease(
+                REPLICA_LEASE_PREFIX + self.identity, self.identity,
+                cfg.lease_duration)
+        except Exception as err:  # noqa: BLE001 — membership heartbeat is best-effort per tick
+            log.warning("replica lease heartbeat failed: %s", err)
+        members = self.members()
+        with self._lock:
+            self._members_cache = list(members)
+        adopted: List[int] = []
+        dropped: List[int] = []
+        held_now = 0
+        for shard in range(cfg.num_shards):
+            desired = self.desired_owner(shard, members) == self.identity
+            acquired = False
+            # Expiry computed from a timestamp taken BEFORE the acquire
+            # call goes out: the store stamps its own expiry no earlier
+            # than this, so claiming asked_at+duration can only
+            # under-claim — never claim ownership past the store's own
+            # expiry.  (Stamping after the call is a real split-brain
+            # window: time that passes DURING the acquire would extend our
+            # local claim beyond the lease a peer sees expire — the
+            # interleaving explorer's shard-lease scenario catches exactly
+            # this.)
+            asked_at = clock.now()
+            expiry = asked_at + cfg.lease_duration
+            if desired:
+                try:
+                    acquired = self.cluster.try_acquire_lease(
+                        shard_lease_name(shard), self.identity,
+                        cfg.lease_duration)
+                except Exception as err:  # noqa: BLE001 — a failed renew is a drop, not a crash
+                    log.warning("shard %d lease renew failed: %s", shard, err)
+            # One critical section per shard decides everything about
+            # _owned — check and act are never split across acquisitions.
+            release_needed = False
+            with self._lock:
+                entry = self._owned.get(shard)
+                # "Held" means we never stopped CLAIMING it: the recorded
+                # expiry (minus margin — owns()'s own rule) was still in
+                # the future when this tick asked.  An entry that lapsed
+                # (a stalled renew thread, say) does NOT count: workers
+                # already began absorbing its keys on the ownership fence,
+                # so a successful re-acquire below must be a full adoption
+                # (on_adopt replays the shard) — treating it as a renewal
+                # would strand every key absorbed during the lapse until
+                # the next resync backstop tick.
+                held = (entry is not None
+                        and asked_at < entry - cfg.effective_margin())
+                if acquired:
+                    self._owned[shard] = expiry
+                    if not held:
+                        adopted.append(shard)
+                        self._adoptions += 1
+                elif entry is not None and desired and held:
+                    # Renew failed (wire blip, throttle) while OUR store
+                    # lease is still unexpired: no peer can acquire it
+                    # before that expiry, so keep claiming and retry next
+                    # tick.  Dropping here would purge the shard's queue
+                    # and force a full adoption replay per transient blip
+                    # (a fleet-wide replay storm at 10k jobs); if renews
+                    # keep failing, owns() lapses at expiry−margin on its
+                    # own — the same fence a wedged renew thread gets —
+                    # and the next tick takes the drop branch below.
+                    pass
+                elif entry is not None:
+                    # The assignment moved the shard away, or the entry
+                    # already lapsed.  Stop claiming NOW, and never leave
+                    # a lapsed entry behind (it would inflate the held
+                    # gauge and turn the eventual re-acquire into a
+                    # silent renewal).  Order matters on the voluntary
+                    # path: drop from _owned first (owns() flips False),
+                    # THEN release the lease outside the lock so the new
+                    # owner can acquire — the reverse order would let two
+                    # replicas answer owns()=True at once.
+                    del self._owned[shard]
+                    dropped.append(shard)
+                    self._drops += 1
+                    release_needed = not desired
+                held_now = len(self._owned)
+            if release_needed:
+                self._release(shard_lease_name(shard))
+        metrics.shard_leases_held.labels(self.identity).set(float(held_now))
+        for shard in dropped:
+            metrics.shard_drops.labels(self.identity).inc()
+            self._fire(self.on_drop, shard)
+        for shard in adopted:
+            metrics.shard_adoptions.labels(self.identity).inc()
+            self._fire(self.on_adopt, shard)
+
+    def _fire(self, callback: Optional[Callable[[int], None]], shard: int) -> None:
+        if callback is None:
+            return
+        try:
+            callback(shard)
+        except Exception as err:  # noqa: BLE001 — a callback error must not kill the renew loop
+            log.warning("shard %d ownership callback failed: %s", shard, err)
+
+    def _release(self, name: str) -> None:
+        release = getattr(self.cluster, "release_lease", None)
+        if release is None:
+            return  # the lease simply expires; expiry-based handoff covers it
+        try:
+            release(name, self.identity)
+        except Exception as err:  # noqa: BLE001 — expiry is the backstop
+            log.warning("releasing lease %s failed: %s", name, err)
+
+    # ------------------------------------------------------------------
+    # ownership queries
+
+    def owns(self, shard: int) -> bool:
+        """True only while OUR lease on `shard` is unexpired with margin to
+        spare.  This is the fence every enqueue and every worker pop checks:
+        once it flips False, nothing new is synced on this shard even if the
+        renew thread is wedged."""
+        now = clock.now()
+        with self._lock:
+            expiry = self._owned.get(shard)
+        return (expiry is not None
+                and now < expiry - self.config.effective_margin())
+
+    def owned_shards(self) -> List[int]:
+        """Shards owns() currently answers True for (sorted)."""
+        now = clock.now()
+        with self._lock:
+            snapshot = dict(self._owned)
+        margin = self.config.effective_margin()
+        return sorted(s for s, exp in snapshot.items() if now < exp - margin)
+
+    def report(self) -> dict:
+        """Federation section of the deep health report.  `members` is the
+        LAST TICK's view, not a fresh read: report() serves /healthz, and a
+        wire LIST here would couple probe latency to the apiserver — the
+        hang-coupling the watchdog machinery deliberately avoids."""
+        with self._lock:
+            adoptions, drops = self._adoptions, self._drops
+            members = list(self._members_cache)
+        return {
+            "identity": self.identity,
+            "num_shards": self.config.num_shards,
+            "owned": self.owned_shards(),
+            "members": members,
+            "adoptions": adoptions,
+            "drops": drops,
+            "lease_duration_seconds": self.config.lease_duration,
+            "renew_period_seconds": self.config.renew_period,
+        }
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def start(self) -> None:
+        """First tick runs synchronously so a fresh replica owns its share
+        before the controller's workers start; then the renew loop takes
+        over.  Idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self.tick()
+        thread = threading.Thread(target=self._loop,
+                                  name="tpujob-shardlease", daemon=True)
+        self._thread = thread
+        thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(timeout=self.config.renew_period):
+            try:
+                self.tick()
+            except Exception as err:  # noqa: BLE001 — the renew loop must outlive any tick
+                log.warning("shard lease tick failed: %s", err)
+
+    def stop(self, release: bool = True) -> None:
+        """Stop renewing.  `release=True` (graceful shutdown) hands every
+        owned shard back immediately so survivors adopt without waiting out
+        the lease; `release=False` models a crash — the leases age out.
+        Idempotent: the second call is a no-op, so a test that crash-stops
+        the manager before controller.stop() keeps crash semantics."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)
+        with self._lock:
+            owned = list(self._owned)
+            self._owned.clear()
+        metrics.shard_leases_held.labels(self.identity).set(0.0)
+        if release:
+            for shard in owned:
+                self._release(shard_lease_name(shard))
+            # Leave the membership too: peers recompute the assignment
+            # without us on their next tick and adopt the released shards
+            # immediately instead of waiting out the replica lease.
+            self._release(REPLICA_LEASE_PREFIX + self.identity)

@@ -233,6 +233,24 @@ class RateLimitingQueue:
             out["_sample"] = sample
         return out
 
+    def purge(self) -> int:
+        """Drop every queued, dirty, delayed, and backoff-tracked key —
+        shard handoff (runtime/shardlease.py): the keys belong to another
+        replica now, and popping them one by one just to skip each on the
+        ownership fence would churn the worker pool.  Keys currently being
+        processed are left to finish (their done() will not redeliver —
+        the dirty mark is gone).  Returns how many keys were dropped."""
+        with self._cond:
+            dropped = len(self._queue) + len(self._pending)
+            self._queue.clear()
+            self._dirty.clear()
+            self._enqueued_at.clear()
+            self._pending.clear()
+            self._deadlines.clear()
+            self._failures.clear()
+        self._timer_wake.set()  # re-evaluate the (now empty) deadline heap
+        return dropped
+
     # --- lifecycle ---
 
     def shutdown(self) -> None:
@@ -303,6 +321,10 @@ class ShardedWorkQueue:
 
     def done(self, key: str) -> None:
         self.shard_of(key).done(key)
+
+    def purge_shard(self, index: int) -> int:
+        """Drop shard `index`'s queued/delayed keys (lease handoff)."""
+        return self.shards[index].purge()
 
     # --- observability ---
 

@@ -20,6 +20,7 @@ from typing import Optional
 from .. import __version__
 from ..controller.controller import TPUJobController
 from ..controller.health import SelfHealingConfig
+from ..runtime.shardlease import ShardLeaseConfig
 from .probes import probe_response
 from ..runtime.cluster import ClusterInterface, InMemoryCluster
 from ..runtime.local import LocalProcessCluster
@@ -32,6 +33,17 @@ LEASE_DURATION = 15.0
 RENEW_PERIOD = 5.0
 RETRY_PERIOD = 3.0
 LEASE_NAME = "tpu-operator-leader"
+
+
+class _DeprecatedResycPeriod(argparse.Action):
+    """The reference's misspelled flag, kept as a hidden alias: stores into
+    resync_period like the canonical flag, warns exactly once per parse."""
+
+    def __call__(self, parser, namespace, values, option_string=None):
+        tpulog.logger_for_key("server").warning(
+            "%s is deprecated (the reference's typo, options.go:79); "
+            "use --resync-period", option_string)
+        setattr(namespace, self.dest, values)
 
 
 def build_arg_parser() -> argparse.ArgumentParser:
@@ -69,10 +81,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     parser.add_argument("--api-port", type=int, default=8008,
                         help="REST API port; 0 disables")
     parser.add_argument("--resync-period", type=float, default=15.0)
-    # the reference's actual spelling is the typo'd --resyc-period
-    # (options.go:79); accept it so reference Deployment args run
-    # unmodified, without advertising it in --help
+    # The reference's actual spelling is the typo'd --resyc-period
+    # (options.go:79); accept it as a hidden deprecated alias so reference
+    # Deployment args run unmodified, without advertising it in --help.
+    # --resync-period is the canonical name; using the typo logs a
+    # deprecation warning once per parse.
     parser.add_argument("--resyc-period", dest="resync_period", type=float,
+                        action=_DeprecatedResycPeriod,
                         default=argparse.SUPPRESS, help=argparse.SUPPRESS)
     parser.add_argument("--enable-leader-election", action="store_true")
     parser.add_argument("--workdir", default=".tpujob-local",
@@ -127,6 +142,33 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         help="disable the shared informer cache: every sync "
                              "reads the apiserver directly (pre-informer "
                              "behavior; for debugging and A/B only)")
+    # Federated fleet (runtime/shardlease.py, docs/federation.md): N
+    # controller replicas split the shard space via per-shard leases with
+    # deterministic rebalancing; replica death hands its shards to
+    # survivors within --shard-lease-duration.
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="controller replicas to run IN THIS PROCESS, "
+                             "federated via shard leases (memory/local "
+                             "runtimes; on Kubernetes run one replica per "
+                             "pod with --enable-shard-leases instead). "
+                             ">1 implies shard leases")
+    parser.add_argument("--enable-shard-leases", action="store_true",
+                        help="participate in a cross-process fleet: sync "
+                             "only the shards whose coordination.k8s.io "
+                             "leases this replica holds (supersedes "
+                             "--enable-leader-election's 1-owns-all model)")
+    parser.add_argument("--shard-lease-duration", type=float, default=15.0,
+                        help="seconds a shard/replica lease lives without "
+                             "renewal; bounds crash-failover latency")
+    parser.add_argument("--shard-lease-renew", type=float, default=5.0,
+                        help="seconds between shard lease renew/rebalance "
+                             "ticks (keep well under the duration)")
+    parser.add_argument("--full-resync-every", type=int, default=4,
+                        help="every Nth resync tick enqueues ALL jobs; the "
+                             "ticks between skip jobs whose last sync was "
+                             "a verified no-op (event-driven reconcile: "
+                             "idle jobs cost zero CPU). 1 restores the "
+                             "classic enqueue-everything tick")
     return parser
 
 
@@ -201,6 +243,36 @@ def start_monitoring(port: int, host: str = "0.0.0.0",
                               name="tpujob-monitoring")
     thread.start()
     return server
+
+
+def fleet_health_provider(controllers):
+    """Aggregate /healthz across an in-process federated fleet
+    (--replicas N, docs/federation.md): live/ready only when EVERY replica
+    is — a wedged peer must flip the probe even though the primary is
+    fine, or its shards go unsynced behind a green readiness gate.  Each
+    replica's full report rides along under `replicas`, keyed by
+    identity, with reasons prefixed so a 503 names the offender."""
+
+    def provider() -> dict:
+        reports = {c.identity: c.health_report() for c in controllers}
+        live = all(r.get("live") for r in reports.values())
+        ready = all(r.get("ready") for r in reports.values())
+        reasons = [
+            f"{identity}: {reason}"
+            for identity, r in reports.items()
+            for reason in r.get("reasons", ())
+        ]
+        return {
+            # same legacy contract as the solo report: old SDK pollers
+            # check status == "ok"
+            "status": "ok" if ready else "not-ready",
+            "live": live,
+            "ready": ready,
+            "reasons": reasons,
+            "replicas": reports,
+        }
+
+    return provider
 
 
 class LeaderElector:
@@ -329,17 +401,63 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
         quarantine_probation=args.quarantine_probation,
         stuck_sync_deadline=args.stuck_sync_deadline,
         watch_stale_deadline=args.watch_stale_deadline,
+        full_resync_every=args.full_resync_every,
     )
-    controller = TPUJobController(
-        cluster,
-        config=config,
-        threadiness=args.threadiness,
-        healing=healing,
-        shards=args.reconcile_shards,
-        use_informer=args.use_informer,
-        informer_relist_period=args.informer_relist_period,
-        **({"resolver": resolver_owner.resolver} if resolver_owner else {}),
-    )
+
+    # Federation (docs/federation.md): shard leases split the key space
+    # across replicas — in this process (--replicas N) or across pods
+    # (--enable-shard-leases, one replica per pod sharing the cluster's
+    # lease store).
+    replicas = max(1, args.replicas)
+    shard_leases_on = replicas > 1 or args.enable_shard_leases
+    if shard_leases_on and args.enable_leader_election:
+        raise SystemExit(
+            "--enable-leader-election (1-owns-all) and shard leases "
+            "(--replicas > 1 / --enable-shard-leases) are mutually "
+            "exclusive: shard leases ARE the generalized election — every "
+            "replica leads its own shards"
+        )
+    if shard_leases_on and gang_in_process:
+        raise SystemExit(
+            "shard leases (--replicas > 1 / --enable-shard-leases) with "
+            "--gang-mechanism podgroup would run one in-process gang "
+            "scheduler per ACTIVE replica against shared slice capacity "
+            "(every shard-lease replica is active, unlike leader-election "
+            "standbys); run gang admission in one solo process or "
+            "delegate it (--gang-mechanism volcano/pdb)"
+        )
+
+    def shard_lease_config():
+        return (ShardLeaseConfig(
+                    num_shards=args.reconcile_shards,
+                    lease_duration=args.shard_lease_duration,
+                    renew_period=args.shard_lease_renew)
+                if shard_leases_on else None)
+
+    import os as os_mod
+    import socket as socket_mod
+
+    base_identity = f"{socket_mod.gethostname()}-{os_mod.getpid()}"
+
+    def build_controller(index: int) -> TPUJobController:
+        return TPUJobController(
+            cluster,
+            config=config,
+            threadiness=args.threadiness,
+            healing=healing,
+            shards=args.reconcile_shards,
+            use_informer=args.use_informer,
+            informer_relist_period=args.informer_relist_period,
+            shard_lease=shard_lease_config(),
+            identity=(base_identity if replicas == 1
+                      else f"{base_identity}-r{index}"),
+            **({"resolver": resolver_owner.resolver} if resolver_owner else {}),
+        )
+
+    controller = build_controller(0)
+    # Peer replicas of the in-process fleet: started with the primary,
+    # stopped with it.  Each owns its lease-assigned share of the shards.
+    peers = [build_controller(i) for i in range(1, replicas)]
     if getattr(args, "slice_inventory", None) and not gang_in_process:
         raise SystemExit(
             "--slice-inventory requires --enable-gang-scheduling with "
@@ -401,6 +519,11 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
     if args.enable_leader_election:
         def health_provider() -> dict:
             return controller.health_report(standby_ok=True)
+    elif peers:
+        # In-process fleet: a probe must see EVERY replica, not just the
+        # primary — a wedged peer's shards would otherwise go unsynced
+        # behind a green readiness gate (docs/federation.md).
+        health_provider = fleet_health_provider([controller, *peers])
     else:
         health_provider = controller.health_report
     monitoring = start_monitoring(args.monitoring_port,
@@ -444,11 +567,15 @@ def run(argv=None, cluster: Optional[ClusterInterface] = None) -> TPUJobControll
     else:
         metrics.is_leader.labels().set(1)
         try:
+            for peer in peers:
+                peer.start()
             controller.run()
         except KeyboardInterrupt:
             pass
         finally:
             controller.stop()
+            for peer in peers:
+                peer.stop()
             monitoring.shutdown()
             if api:
                 api.shutdown()

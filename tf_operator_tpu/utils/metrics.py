@@ -259,3 +259,41 @@ api_requests = REGISTRY.counter(
     "Apiserver requests issued by this process's client, by verb",
     ("verb",),
 )
+# Coalescing status writer (runtime/statuswriter.py, docs/federation.md):
+# writes_total counts status PUTs that actually hit the wire; coalesced_total
+# counts transitions absorbed without one — no-op passes echoing a stale
+# informer read of our own last write, plus the extra transitions of a
+# multi-transition pass merged into a single PUT.  Together they make the
+# write-coalescing win assertable deterministically: per-job wire cost is
+# writes_total/jobs, and coalesced_total > 0 proves the optimization fired.
+status_writes = REGISTRY.counter(
+    "tpujob_status_writes_total",
+    "TPUJob status PUTs actually sent to the apiserver",
+)
+status_writes_coalesced = REGISTRY.counter(
+    "tpujob_status_writes_coalesced_total",
+    "Status transitions absorbed without a wire write (stale-read echoes "
+    "suppressed + extra transitions merged into one PUT)",
+)
+# Shard-lease federation (runtime/shardlease.py, docs/federation.md): how
+# many shard leases each replica currently holds, and the handoff churn.
+# A healthy fleet shows leases_held summing to the shard count with
+# adoptions/drops flat; a replica death shows one burst of adoptions on the
+# survivors.
+shard_leases_held = REGISTRY.gauge(
+    "tpujob_shard_leases_held",
+    "Shard leases this replica currently holds (sampled per renew tick)",
+    ("replica",),
+)
+shard_adoptions = REGISTRY.counter(
+    "tpujob_shard_adoptions_total",
+    "Shard leases newly acquired by this replica (initial claim, "
+    "rebalance, or adoption of a dead peer's shards)",
+    ("replica",),
+)
+shard_drops = REGISTRY.counter(
+    "tpujob_shard_drops_total",
+    "Shard leases this replica stopped holding (rebalance away, failed "
+    "renew, or shutdown)",
+    ("replica",),
+)
