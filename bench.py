@@ -61,7 +61,10 @@ BENCH_K8S_SOAK_10K=1 (adds the 10,000-job FEDERATED-fleet arm:
 BENCH_K8S_REPLICAS shard-lease replicas, default 3, emitting
 k8s_soak_10000_jobs_sec, per-job status-write cost, and per-replica
 queue-latency p99 — docs/federation.md; BENCH_K8S_SOAK_10K_JOBS scales
-the job count for smoke runs).
+the job count for smoke runs).  BENCH_ZERO=1 adds the ZeRO weight-update
+sharding A/B arm (lm_opt_state_bytes_per_device + zero on/off tokens/sec
+at dp>=2; BENCH_ZERO_DEVICES virtual devices on the CPU fallback,
+default 4 — docs/zero-sharding.md).
 """
 from __future__ import annotations
 
@@ -321,6 +324,32 @@ def _control_plane(stages):
     return result or None
 
 
+def _zero_ab(stages, platform):
+    """ZeRO weight-update sharding A/B (docs/zero-sharding.md), env-gated
+    BENCH_ZERO=1 so smoke runs never pay the extra compiles: zero=on/off
+    tokens/sec pair + opt-state bytes/device at dp>=2.  On the CPU fallback
+    the child forces BENCH_ZERO_DEVICES virtual devices (default 4) — its
+    own process, so the headline arm's device count is untouched."""
+    if os.environ.get("BENCH_ZERO") != "1":
+        return None
+    env = {}
+    if platform is None:
+        env["TPUJOB_FORCE_PLATFORM"] = "cpu"
+        env["BENCH_ZERO_DEVICES"] = os.environ.get("BENCH_ZERO_DEVICES", "4")
+    t0 = time.time()
+    rc, out, err = _run(
+        [sys.executable, os.path.abspath(__file__), "--child-zero"],
+        env, CHILD_TIMEOUT,
+    )
+    parsed = _last_json(out)
+    ok = parsed is not None and "error" not in (parsed or {})
+    stages.append({"stage": "zero_ab", "rc": rc,
+                   "sec": round(time.time() - t0, 1), "ok": ok,
+                   **({} if ok else
+                      {"err": (parsed or {}).get("error") or err[-300:]})})
+    return parsed if ok else None
+
+
 def _native(stages):
     if os.environ.get("BENCH_SKIP_NATIVE"):
         return None
@@ -398,7 +427,11 @@ def orchestrate() -> None:
         stages.append({"stage": "orchestrator", "err": repr(e)[:300]})
     if not attention_done:
         _run_attention()
-    cp = native = None
+    cp = native = zero = None
+    try:
+        zero = _zero_ab(stages, platform)
+    except Exception as e:  # noqa: BLE001
+        stages.append({"stage": "zero_ab", "err": repr(e)[:300]})
     try:
         cp = _control_plane(stages)
     except Exception as e:  # noqa: BLE001
@@ -429,6 +462,8 @@ def orchestrate() -> None:
         headline["control_plane"] = cp
     if native:
         headline["native"] = native
+    if zero:
+        headline["zero"] = zero
     headline["stages"] = stages
     print(json.dumps(_compact_summary(headline)))
 
@@ -778,6 +813,107 @@ def child_throughput() -> None:
             out["mfu"] = round(mfu_of(fw_sps * per_step), 4)
             out["mfu_baseline"] = round(mfu_of(bare_sps * per_step), 4)
     print(json.dumps(out))
+
+
+# ---------------------------------------------------------------------------
+# Child: ZeRO weight-update sharding A/B (BENCH_ZERO=1)
+# ---------------------------------------------------------------------------
+
+def child_zero() -> None:
+    """lm tokens/sec with the weight update dense vs dp-sharded
+    (train/zero.py), plus `lm_opt_state_bytes_per_device` both ways — the
+    memory claim is exact arithmetic, the throughput pair is the measured
+    cost/benefit of the reduce-scatter/all-gather layout at this dp."""
+    # Virtual device fan-out must land before the first jax import.
+    ndev_req = int(os.environ.get("BENCH_ZERO_DEVICES", "0"))
+    if ndev_req > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={ndev_req}"
+            ).strip()
+    from tf_operator_tpu.workloads.runner import apply_forced_platform
+
+    apply_forced_platform()
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models.transformer import (
+        TransformerConfig, TransformerLM,
+    )
+    from tf_operator_tpu.parallel.mesh import build_mesh
+    from tf_operator_tpu.parallel.tp_rules import make_param_shardings
+    from tf_operator_tpu.train.optim import lm_optimizer
+    from tf_operator_tpu.train.state import create_train_state
+    from tf_operator_tpu.train.step import (
+        lm_loss_fn, make_train_step, shard_batch, shard_train_state,
+    )
+    from tf_operator_tpu.train.zero import (
+        build_zero_plan, opt_state_bytes_per_device,
+    )
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        print(json.dumps({"metric": "lm_zero_ab",
+                          "skipped": f"dp={ndev} < 2 (nothing to shard)"}))
+        return
+    steps = int(os.environ.get("BENCH_STEPS", "6"))
+    windows = max(3, int(os.environ.get("BENCH_WINDOWS", "3")))
+    seq = int(os.environ.get("BENCH_SEQ", "256"))
+    batch_size = int(os.environ.get("BENCH_BATCH", str(2 * ndev)))
+    batch_size = max(ndev, batch_size // ndev * ndev)  # dp must divide batch
+    cfg = TransformerConfig(
+        vocab_size=int(os.environ.get("BENCH_LM_VOCAB", "8192")),
+        num_layers=int(os.environ.get("BENCH_LM_LAYERS", "2")),
+        num_heads=int(os.environ.get("BENCH_LM_HEADS", "4")),
+        d_model=int(os.environ.get("BENCH_LM_DMODEL", "256")),
+        d_ff=int(os.environ.get("BENCH_LM_DFF", "1024")),
+        max_len=seq, causal=True,
+    )
+    mesh = build_mesh({"dp": ndev})
+    model = TransformerLM(cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (batch_size, seq + 1)), jnp.int32)
+    batch = shard_batch({"tokens": tokens}, mesh)
+    example = tokens[:2, :-1]
+    shapes = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), example)["params"]
+    plan = build_zero_plan(
+        shapes, mesh, base_specs=make_param_shardings(shapes, mesh))
+
+    timers = {}
+    for arm, arm_plan in (("off", None), ("on", plan)):
+        tx = lm_optimizer(3e-4, zero_plan=arm_plan,
+                          mesh=mesh if arm_plan is not None else None)
+        state = create_train_state(
+            jax.random.PRNGKey(0), model, tx, example, zero_plan=arm_plan)
+        state = shard_train_state(state, mesh, zero_plan=arm_plan)
+        raw = make_train_step(lm_loss_fn(model.apply), jit=False)
+        timers[arm] = _window_timer(raw, state, batch, steps)
+    # Interleaved windows, same discipline as the main arm: both arms see
+    # the same instantaneous host conditions, ratio is per-pair median.
+    per_step = batch_size * seq
+    on_w, off_w, ratios = [], [], []
+    for _ in range(windows):
+        off_w.append(timers["off"]() * per_step)
+        on_w.append(timers["on"]() * per_step)
+        ratios.append(on_w[-1] / off_w[-1])
+    bytes_on = opt_state_bytes_per_device(plan, shapes)
+    bytes_off = opt_state_bytes_per_device(None, shapes)
+    print(json.dumps({
+        "metric": "lm_zero_ab",
+        "dp": ndev,
+        "lm_opt_state_bytes_per_device": bytes_on,
+        "lm_opt_state_bytes_per_device_dense": bytes_off,
+        "opt_state_shrink": round(bytes_off / bytes_on, 3),
+        "zero_on_tokens_per_sec": round(statistics.median(on_w), 2),
+        "zero_off_tokens_per_sec": round(statistics.median(off_w), 2),
+        "zero_on_vs_off": round(statistics.median(ratios), 4),
+    }))
 
 
 # ---------------------------------------------------------------------------
@@ -1295,6 +1431,8 @@ def child_native() -> None:
 if __name__ == "__main__":
     if "--child-throughput" in sys.argv:
         child_throughput()
+    elif "--child-zero" in sys.argv:
+        child_zero()
     elif "--child-attention" in sys.argv:
         child_attention()
     elif "--child-control-plane" in sys.argv:

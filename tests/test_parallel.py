@@ -8,6 +8,7 @@ from jax.sharding import PartitionSpec as P
 from tf_operator_tpu.parallel.mesh import (
     batch_sharding,
     build_mesh,
+    free_dim_partition_spec,
     local_batch_size,
     param_partition_spec,
 )
@@ -53,6 +54,42 @@ class TestMesh:
         mesh = build_mesh({"fsdp": 8})
         assert param_partition_spec((512, 128), mesh) == P(None, "fsdp")
         assert param_partition_spec((7,), mesh) == P()
+
+    def test_param_partition_spec_prefers_last(self):
+        """The fsdp rule keeps prefer='last': both dims divisible -> the
+        trailing one, even when the leading dim is larger."""
+        mesh = build_mesh({"fsdp": 8})
+        assert param_partition_spec((1024, 64), mesh) == P(None, "fsdp")
+
+    def test_free_dim_prefers_largest(self):
+        mesh = build_mesh({"dp": 8})
+        assert free_dim_partition_spec((512, 128), mesh, "dp") == P("dp", None)
+        assert free_dim_partition_spec((64, 256), mesh, "dp") == P(None, "dp")
+
+    def test_free_dim_tie_breaks_toward_last(self):
+        mesh = build_mesh({"dp": 8})
+        assert free_dim_partition_spec((128, 128), mesh, "dp") == P(None, "dp")
+        assert free_dim_partition_spec(
+            (64, 64, 64), mesh, "dp") == P(None, None, "dp")
+
+    def test_free_dim_respects_base_layout(self):
+        """Dims already sharded (tp) are not free; the dp axis lands on the
+        largest remaining one, layered onto the base spec."""
+        mesh = build_mesh({"dp": 2, "tp": 4})
+        assert free_dim_partition_spec(
+            (64, 256), mesh, "dp", base=P(None, "tp")) == P("dp", "tp")
+        # base already uses the axis -> unchanged
+        assert free_dim_partition_spec(
+            (64, 256), mesh, "dp", base=P("dp", None)) == P("dp", None)
+
+    def test_free_dim_no_candidate_returns_base(self):
+        mesh = build_mesh({"dp": 8})
+        base = P(None, "tp")
+        assert free_dim_partition_spec((7, 16), mesh, "dp", base=base) is base
+        assert free_dim_partition_spec((7,), mesh, "dp") == P()
+        # axis absent from the mesh -> no-op
+        mesh_tp = build_mesh({"tp": 8})
+        assert free_dim_partition_spec((512, 128), mesh_tp, "dp") == P()
 
 
 class TestTPRules:

@@ -161,6 +161,38 @@ def test_status_round_trip():
     assert conditions.is_running(back.status)
 
 
+def test_zero_shard_knob_and_plan_round_trip():
+    """tpu.zeroShardWeightUpdate and status.zeroShardingPlan survive the
+    wire format (the AMP planner reads the plan back from status)."""
+    from tf_operator_tpu.api.types import TPUTopology, zero_sharding_plan_doc
+
+    job = new_tpujob(worker=2)
+    job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+        topology="2x4", mesh={"dp": 8}, zero_shard_weight_update=True
+    )
+    job.status.zero_sharding_plan = zero_sharding_plan_doc(job.spec)
+    assert job.status.zero_sharding_plan == {
+        "axis": "dp", "numShards": 8, "replicaType": "Worker"}
+    back = job_from_dict(json.loads(json.dumps(job_to_dict(job))))
+    worker = back.spec.replica_specs[ReplicaType.WORKER]
+    assert worker.tpu.zero_shard_weight_update is True
+    assert back.status.zero_sharding_plan == job.status.zero_sharding_plan
+    # knob off -> no doc, and the field serializes as None
+    worker.tpu.zero_shard_weight_update = False
+    assert zero_sharding_plan_doc(back.spec) is None
+
+    # knob on but the explicit mesh runs dense (no dp axis / dp=1):
+    # the doc must stay truthful to what the runtime executes -> None
+    worker.tpu.zero_shard_weight_update = True
+    worker.tpu.mesh = {"tp": 8}
+    assert zero_sharding_plan_doc(back.spec) is None
+    worker.tpu.mesh = {"dp": 1, "tp": 8}
+    assert zero_sharding_plan_doc(back.spec) is None
+    # no explicit mesh: runtime defaults all chips onto dp -> chip count
+    worker.tpu.mesh = {}
+    assert zero_sharding_plan_doc(back.spec)["numShards"] == 8
+
+
 def test_mini_yaml_fallback():
     from tf_operator_tpu.api.serialization import _mini_yaml
 

@@ -134,6 +134,32 @@ class TestTPUEnv:
         assert env[constants.ENV_SLICE_TOPOLOGY] == "2x4"
         assert json.loads(env[constants.ENV_MESH_SHAPE]) == {"dp": 2, "tp": 4}
 
+    def test_zero_shard_knob_round_trips_spec_env_runner(self):
+        """The full knob chain: spec tpu.zeroShardWeightUpdate -> injected
+        TPUJOB_ZERO_SHARD_WEIGHT_UPDATE -> WorkloadContext (the runner-side
+        default for --zero-shard-weight-update in workloads/lm.py)."""
+        from tf_operator_tpu.workloads.runner import WorkloadContext
+
+        job = new_tpujob(worker=2)
+        job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+            topology="2x4", mesh={"dp": 8}, zero_shard_weight_update=True
+        )
+        env = topology.gen_tpu_env(job, ReplicaType.WORKER, 0)
+        assert env[constants.ENV_ZERO_SHARD_WEIGHT_UPDATE] == "1"
+        ctx = WorkloadContext.from_env(env)
+        assert ctx.zero_shard_weight_update is True
+
+    def test_zero_shard_knob_off_by_default(self):
+        from tf_operator_tpu.workloads.runner import WorkloadContext
+
+        job = new_tpujob(worker=2)
+        job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+            topology="2x4", mesh={"dp": 8}
+        )
+        env = topology.gen_tpu_env(job, ReplicaType.WORKER, 0)
+        assert constants.ENV_ZERO_SHARD_WEIGHT_UPDATE not in env
+        assert WorkloadContext.from_env(env).zero_shard_weight_update is False
+
 
 class TestRunConfigFromEnv:
     """Consumer-side TF_CONFIG parsing, RunConfig semantics (the reference

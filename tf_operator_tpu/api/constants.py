@@ -68,6 +68,9 @@ ENV_COORDINATOR_ADDRESS = "TPUJOB_COORDINATOR_ADDRESS"
 ENV_PROCESS_ID = "TPUJOB_PROCESS_ID"
 ENV_NUM_PROCESSES = "TPUJOB_NUM_PROCESSES"
 ENV_MESH_SHAPE = "TPUJOB_MESH_SHAPE"  # json dict axis->size, e.g. {"dp":2,"tp":4}
+# "1" => the training runtime shards optimizer state + weight update over
+# the dp axis (ZeRO-style, train/zero.py; spec knob tpu.zeroShardWeightUpdate)
+ENV_ZERO_SHARD_WEIGHT_UPDATE = "TPUJOB_ZERO_SHARD_WEIGHT_UPDATE"
 ENV_SLICE_TOPOLOGY = "TPUJOB_SLICE_TOPOLOGY"  # e.g. "2x4" chips
 ENV_ACCELERATOR = "TPUJOB_ACCELERATOR"  # e.g. "v5litepod-8"
 ENV_REPLICA_TYPE = "TPUJOB_REPLICA_TYPE"

@@ -77,6 +77,7 @@ def _replica_to_dict(rs: ReplicaSpec) -> Dict[str, Any]:
             "accelerator": rs.tpu.accelerator,
             "topology": rs.tpu.topology,
             "mesh": dict(rs.tpu.mesh),
+            "zeroShardWeightUpdate": rs.tpu.zero_shard_weight_update,
         }
     return out
 
@@ -142,6 +143,7 @@ def status_to_dict(status: JobStatus) -> Dict[str, Any]:
         },
         "startTime": status.start_time,
         "completionTime": status.completion_time,
+        "zeroShardingPlan": status.zero_sharding_plan,
     }
 
 
@@ -198,6 +200,9 @@ def _replica_from_dict(data: Dict[str, Any]) -> ReplicaSpec:
             accelerator=tpu_raw.get("accelerator", ""),
             topology=tpu_raw.get("topology", ""),
             mesh={k: int(v) for k, v in (tpu_raw.get("mesh") or {}).items()},
+            zero_shard_weight_update=bool(
+                tpu_raw.get("zeroShardWeightUpdate", False)
+            ),
         )
     return ReplicaSpec(
         replicas=data.get("replicas"),
@@ -300,6 +305,7 @@ def status_from_dict(data: Dict[str, Any]) -> JobStatus:
         replica_statuses=replica_statuses,
         start_time=data.get("startTime"),
         completion_time=data.get("completionTime"),
+        zero_sharding_plan=data.get("zeroShardingPlan"),
     )
 
 

@@ -153,6 +153,11 @@ class JobStatus:
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     last_reconcile_time: Optional[float] = None
+    # Strategy-level ZeRO weight-update sharding document (see
+    # zero_sharding_plan_doc) stamped by the reconciler when the spec knob
+    # is on — the searchable layout record the AMP planner (ROADMAP item 3)
+    # reads back.  None when the knob is off.
+    zero_sharding_plan: Optional[Dict[str, object]] = None
 
 
 @dataclass
@@ -193,6 +198,11 @@ class TPUTopology:
     # Logical mesh requested for the workload, axis name -> size,
     # e.g. {"dp": 2, "tp": 4}.  Injected as TPUJOB_MESH_SHAPE.
     mesh: Dict[str, int] = field(default_factory=dict)
+    # ZeRO-style cross-replica sharding of optimizer state + weight update
+    # over the mesh's data-parallel axis (train/zero.py, arXiv:2004.13336).
+    # Injected as TPUJOB_ZERO_SHARD_WEIGHT_UPDATE; the reconciler mirrors
+    # the chosen strategy into status.zero_sharding_plan.
+    zero_shard_weight_update: bool = False
 
     def num_chips(self) -> int:
         return topology_chips(self.topology) if self.topology else 0
@@ -254,3 +264,43 @@ def is_evaluator(rtype: ReplicaType) -> bool:
 def contains_chief_or_master(job: TPUJob) -> bool:
     """(ref: pkg/controller.v1/tensorflow/util.go:45-52)"""
     return any(is_chief_or_master(rt) for rt in job.spec.replica_specs)
+
+
+def zero_sharding_plan_doc(spec: TPUJobSpec) -> Optional[Dict[str, object]]:
+    """The strategy-level ZeRO weight-update sharding document for a spec,
+    or None when no replica group asks for it.
+
+    This is the controller-side half of the plan: which replica group, which
+    mesh axis, how many shards.  The per-param half (shard dims) is chosen
+    by the training runtime (train/zero.py) from the live param tree, which
+    the control plane never sees; the AMP planner (ROADMAP item 3) searches
+    over exactly the fields recorded here.  The doc must stay truthful to
+    what the runtime will actually do: an explicit mesh whose dp axis is
+    absent or 1 runs dense (workloads/lm.py announces and skips), so no doc
+    is emitted for it.  Without an explicit mesh the runtime defaults all
+    devices onto dp (mesh_from_env); numShards is then the slice chip count
+    when a topology is declared, else None (sharding active, width unknown
+    to the control plane).
+    """
+    for rtype in REPLICA_TYPE_ORDER:
+        rspec = spec.replica_specs.get(rtype)
+        if rspec is None or rspec.tpu is None:
+            continue
+        if not rspec.tpu.zero_shard_weight_update:
+            continue
+        mesh = rspec.tpu.mesh
+        num_shards: Optional[int] = None
+        if mesh:
+            num_shards = int(mesh.get("dp", 1))
+            if num_shards <= 1:
+                continue  # runtime runs dense on this mesh: no plan
+        elif rspec.tpu.topology:
+            num_shards = rspec.tpu.num_chips() or None
+            if num_shards is not None and num_shards <= 1:
+                continue
+        return {
+            "axis": "dp",
+            "numShards": num_shards,
+            "replicaType": rtype.value,
+        }
+    return None

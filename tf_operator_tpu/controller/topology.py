@@ -160,6 +160,8 @@ def gen_tpu_env(
             env[constants.ENV_MESH_SHAPE] = json.dumps(
                 rspec.tpu.mesh, separators=(",", ":")
             )
+        if rspec.tpu.zero_shard_weight_update:
+            env[constants.ENV_ZERO_SHARD_WEIGHT_UPDATE] = "1"
         _add_multislice_env(env, job, rtype, rspec, index, resolver, warn)
     return env
 

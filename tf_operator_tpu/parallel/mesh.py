@@ -84,25 +84,88 @@ def axis_size(mesh: Mesh, name: str) -> int:
     return mesh.shape[name] if name in mesh.axis_names else 1
 
 
+def _pick_shard_dim(
+    shape: Sequence[int], size: int, prefer: str, taken: Sequence[int] = ()
+) -> Optional[int]:
+    """The shared dim-picking core behind param_partition_spec and
+    free_dim_partition_spec: among dims not in `taken` that the axis size
+    divides (and that are >= size, so every shard is non-empty), pick
+
+      prefer="last":    the last candidate (output features usually largest
+                        and contiguity-friendly), or
+      prefer="largest": the largest candidate, ties broken toward the last
+                        occurrence (a square kernel shards its trailing dim).
+
+    Returns None when no dim qualifies.
+    """
+    if prefer not in ("last", "largest"):
+        raise ValueError(f"prefer must be 'last'|'largest', got {prefer!r}")
+    taken_set = set(taken)
+    candidates = [
+        i for i, d in enumerate(shape)
+        if i not in taken_set and d % size == 0 and d >= size
+    ]
+    if not candidates:
+        return None
+    if prefer == "last":
+        return candidates[-1]
+    return max(candidates, key=lambda i: (shape[i], i))
+
+
 def param_partition_spec(
     shape: Sequence[int], mesh: Mesh, fsdp_axis: str = AXIS_FSDP
 ) -> P:
-    """FSDP-style weight sharding: shard the largest divisible dim over the
+    """FSDP-style weight sharding: shard the last divisible dim over the
     fsdp axis, replicate otherwise (the ZeRO-3 layout XLA turns into
     all-gather-before-use / reduce-scatter-after-grad; cf. the
     cross-replica weight-update sharding of arXiv:2004.13336)."""
     size = axis_size(mesh, fsdp_axis)
     if size <= 1 or not shape:
         return P()
-    # Prefer the last divisible dim ≥ size (output features usually largest
-    # and contiguity-friendly), else the first divisible one.
-    candidates = [i for i, d in enumerate(shape) if d % size == 0 and d >= size]
-    if not candidates:
+    dim = _pick_shard_dim(shape, size, "last")
+    if dim is None:
         return P()
-    dim = candidates[-1]
     spec = [None] * len(shape)
     spec[dim] = fsdp_axis
     return P(*spec)
+
+
+def free_dim_partition_spec(
+    shape: Sequence[int],
+    mesh: Mesh,
+    axis: str = AXIS_DP,
+    *,
+    base: P = P(),
+    prefer: str = "largest",
+) -> P:
+    """Lay `axis` onto a *free* dim of an (optionally already-sharded)
+    array: the dim the ZeRO-style weight-update sharding (train/zero.py,
+    arXiv:2004.13336) splits optimizer state over, on top of whatever
+    tp/fsdp layout the param already has.
+
+    A dim is free when `base` leaves it unsharded and the axis size divides
+    it; prefer="largest" picks the largest such dim (most even memory
+    savings), ties broken toward the last.  Returns `base` unchanged when
+    the axis is trivial, already used by `base`, or no dim qualifies.
+    """
+    size = axis_size(mesh, axis)
+    base_entries = list(base) + [None] * (len(shape) - len(base))
+    if size <= 1 or not shape:
+        return base
+
+    def axes_of(entry):
+        if entry is None:
+            return ()
+        return entry if isinstance(entry, tuple) else (entry,)
+
+    taken = [i for i, e in enumerate(base_entries) if e is not None]
+    if any(axis in axes_of(e) for e in base_entries):
+        return base
+    dim = _pick_shard_dim(shape, size, prefer, taken)
+    if dim is None:
+        return base
+    base_entries[dim] = axis
+    return P(*base_entries)
 
 
 def shard_params(params, mesh: Mesh):

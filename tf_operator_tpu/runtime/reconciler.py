@@ -41,6 +41,7 @@ from ..api.types import (
     RestartPolicy,
     TPUJob,
     TPUJobSpec,
+    zero_sharding_plan_doc,
 )
 from ..utils import clock
 from ..utils import logging as tpulog
@@ -363,6 +364,13 @@ class JobReconciler:
         # (ref: job.go:217-223; all-or-nothing slice allocation).
         if self.config.enable_gang_scheduling:
             self.sync_gang(job)
+
+        # Mirror the spec's ZeRO weight-update strategy into status so the
+        # chosen layout is a searchable artifact (AMP planner, ROADMAP #3);
+        # cleared when the knob turns off.  The coalescing writer treats a
+        # changed plan as a status transition, so this costs one write when
+        # it changes and zero while it is stable.
+        job.status.zero_sharding_plan = zero_sharding_plan_doc(job.spec)
 
         # Fresh replica-status accounting for this pass
         # (ref: initializeReplicaStatuses, common/status.go).

@@ -25,6 +25,7 @@ avoidable PUT matters.  Three coalescing rules, all per sync pass:
 """
 from __future__ import annotations
 
+import json
 from collections import OrderedDict
 from typing import Callable, Optional, Tuple
 
@@ -52,6 +53,10 @@ def snapshot_status(status: JobStatus) -> Tuple:
         ),
         status.start_time,
         status.completion_time,
+        # Canonical JSON keeps the snapshot hashable (the doc is a dict);
+        # stamping/clearing the plan must count as a status change.
+        json.dumps(status.zero_sharding_plan, sort_keys=True)
+        if status.zero_sharding_plan is not None else None,
     )
 
 

@@ -9,7 +9,13 @@ that restarts (ExitCode/137) resumes from the latest step.
 
 Orbax handles sharded arrays natively: on restore the target shardings come
 from the live TrainState template, so a checkpoint written on one mesh can be
-read on another (elastic resume).
+read on another (elastic resume).  That same contract covers ZeRO-sharded
+optimizer state (train/zero.py): moments saved sharded over dp=N restore
+onto a template whose plan was built for dp=M — the template's shardings ARE
+the new plan's layout, so the restore re-shards (docs/zero-sharding.md).
+The plan a checkpoint was written under is persisted as a JSON sidecar
+(`zero_plan-<step>.json`) next to the step directory, so a resuming process
+can inspect what layout the bytes describe before deciding its own.
 """
 from __future__ import annotations
 
@@ -51,9 +57,53 @@ class CheckpointManager:
         if state.batch_stats is not None:
             payload["batch_stats"] = state.batch_stats
         self._manager().save(step, args=ocp.args.StandardSave(payload))
+        if state.zero_plan is not None:
+            # Sidecar, not part of the orbax payload: the plan is layout
+            # metadata about the arrays, not an array, and must stay
+            # readable without materializing a template.
+            with open(self._plan_path(step), "w") as f:
+                f.write(state.zero_plan.to_json())
+        self._prune_plan_sidecars(keep_also=step)
         if wait:
             self._manager().wait_until_finished()
         return step
+
+    def _plan_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"zero_plan-{step}.json")
+
+    def _prune_plan_sidecars(self, keep_also: int) -> None:
+        """Follow orbax's max_to_keep GC: a sidecar must not outlive its
+        step directory (saved_zero_plan would describe deleted bytes).
+        The just-saved step is kept even while its async write is in
+        flight (all_steps may not list it yet)."""
+        keep = set(self._manager().all_steps()) | {keep_also}
+        for name in os.listdir(self.directory):
+            if not (name.startswith("zero_plan-") and name.endswith(".json")):
+                continue
+            try:
+                step = int(name[len("zero_plan-"):-len(".json")])
+            except ValueError:
+                continue
+            if step not in keep:
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                except OSError:
+                    pass  # lint: allow(swallow)
+
+    def saved_zero_plan(self, step: Optional[int] = None, mesh=None):
+        """The ZeroShardingPlan checkpoint `step` (default latest) was
+        written under, or None for dense checkpoints.  Pass the resuming
+        process's `mesh` when the plan will be installed on a TrainState:
+        a mesh-less plan cannot pin the updated-params all-gather in
+        apply_gradients (the per-step layout flip that pin exists to
+        prevent — docs/zero-sharding.md)."""
+        from .zero import ZeroShardingPlan
+
+        step = self.latest_step() if step is None else step
+        if step is None or not os.path.exists(self._plan_path(step)):
+            return None
+        with open(self._plan_path(step)) as f:
+            return ZeroShardingPlan.from_json(f.read(), mesh=mesh)
 
     def latest_step(self) -> Optional[int]:
         return self._manager().latest_step()

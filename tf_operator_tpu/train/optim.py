@@ -50,8 +50,15 @@ def lr_schedule(peak_lr: float, *, schedule: str = "constant",
 def lm_optimizer(peak_lr: float, *, schedule: str = "constant",
                  warmup_steps: int = 0, total_steps: Optional[int] = None,
                  weight_decay: float = 0.1, grad_clip: float = 1.0,
-                 b1: float = 0.9, b2: float = 0.95):
-    """AdamW + clipping + masked decay under the configured schedule."""
+                 b1: float = 0.9, b2: float = 0.95,
+                 zero_plan=None, mesh=None):
+    """AdamW + clipping + masked decay under the configured schedule.
+
+    With `zero_plan` (a train/zero.py ZeroShardingPlan) and its `mesh`, the
+    whole chain is wrapped so optimizer state and the weight update shard
+    over the plan's dp axis (ZeRO-style, arXiv:2004.13336) — clipping stays
+    inside the wrapper, so the global norm is computed once over the
+    logically-global gradients, not per shard."""
     sched = lr_schedule(peak_lr, schedule=schedule,
                         warmup_steps=warmup_steps, total_steps=total_steps)
     parts = []
@@ -59,4 +66,11 @@ def lm_optimizer(peak_lr: float, *, schedule: str = "constant",
         parts.append(optax.clip_by_global_norm(grad_clip))
     parts.append(optax.adamw(sched, b1=b1, b2=b2,
                              weight_decay=weight_decay, mask=decay_mask))
-    return optax.chain(*parts)
+    tx = optax.chain(*parts)
+    if zero_plan is not None:
+        if mesh is None:
+            raise ValueError("zero_plan needs the mesh it was built for")
+        from .zero import zero_shard_optimizer
+
+        tx = zero_shard_optimizer(tx, zero_plan, mesh)
+    return tx

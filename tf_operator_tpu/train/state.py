@@ -21,10 +21,24 @@ class TrainState:
     tx: optax.GradientTransformation = flax.struct.field(
         pytree_node=False, default=None
     )
+    # ZeRO-style weight-update sharding plan (train/zero.py), carried out of
+    # the pytree so checkpointing can persist it alongside the arrays and a
+    # resumed process on a different dp size can re-shard deliberately.
+    zero_plan: Any = flax.struct.field(pytree_node=False, default=None)
 
     def apply_gradients(self, grads, new_batch_stats=None) -> "TrainState":
         updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
         new_params = optax.apply_updates(self.params, updates)
+        if self.zero_plan is not None and self.zero_plan.mesh is not None:
+            # Pin the all-gather of the updated shards on the params
+            # themselves: the apply_updates add has no annotation, and
+            # XLA's propagation would otherwise keep the output in the
+            # weight-update layout — correct, but a per-step layout flip
+            # against the forward pass (see docs/zero-sharding.md).
+            from .zero import constrain_to_base
+
+            new_params = constrain_to_base(
+                new_params, self.zero_plan, self.zero_plan.mesh)
         return self.replace(
             step=self.step + 1,
             params=new_params,
@@ -42,6 +56,7 @@ def create_train_state(
     example_input,
     extra_init_args: tuple = (),
     init_kwargs: Optional[dict] = None,
+    zero_plan: Any = None,
 ) -> TrainState:
     variables = model.init(rng, example_input, *extra_init_args, **(init_kwargs or {}))
     params = variables["params"]
@@ -55,4 +70,5 @@ def create_train_state(
         batch_stats=batch_stats,
         apply_fn=model.apply,
         tx=tx,
+        zero_plan=zero_plan,
     )

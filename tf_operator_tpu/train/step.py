@@ -291,27 +291,27 @@ def make_eval_step(metric_fn, jit: bool = True):
     return jax.jit(step) if jit else step
 
 
-def shard_train_state(state: TrainState, mesh: Mesh) -> TrainState:
-    """Place params/opt_state per tp+fsdp rules, everything else replicated."""
+def shard_train_state(
+    state: TrainState, mesh: Mesh, zero_plan=None
+) -> TrainState:
+    """Place params/opt_state per tp+fsdp rules, everything else replicated.
+
+    Optimizer moments (mu/nu) mirror the param tree; each moment leaf is
+    matched to its param by tree-path suffix + shape (train/zero.py — never
+    shape alone: two params can share a shape) and placed on that param's
+    layout.  With `zero_plan` (ZeRO-style weight-update sharding), moments
+    additionally shard over the plan's dp axis; unmatched leaves (step
+    counts, empty states) replicate either way.
+    """
+    from .zero import base_placement_plan, place_opt_state
+
     param_sh = make_param_shardings(state.params, mesh)
     params = jax.device_put(state.params, param_sh)
 
-    # Optimizer moments (mu/nu) mirror the param tree, so a shape-keyed map
-    # recovers each moment's layout; scalars (e.g. adam count) replicate.
-    by_shape = {
-        p.shape: sh
-        for p, sh in zip(
-            jax.tree_util.tree_leaves(state.params),
-            jax.tree_util.tree_leaves(param_sh),
-        )
-    }
-
-    def opt_sharding(leaf):
-        return by_shape.get(getattr(leaf, "shape", None), replicated(mesh))
-
-    opt_state = jax.tree_util.tree_map(
-        lambda l: jax.device_put(l, opt_sharding(l)), state.opt_state
-    )
+    plan = zero_plan
+    if plan is None:
+        plan = base_placement_plan(state.params, mesh, base_specs=param_sh)
+    opt_state = place_opt_state(state.opt_state, plan, mesh)
     batch_stats = (
         jax.device_put(state.batch_stats, replicated(mesh))
         if state.batch_stats is not None
@@ -322,6 +322,7 @@ def shard_train_state(state: TrainState, mesh: Mesh) -> TrainState:
         params=params,
         opt_state=opt_state,
         batch_stats=batch_stats,
+        zero_plan=zero_plan if zero_plan is not None else state.zero_plan,
     )
 
 

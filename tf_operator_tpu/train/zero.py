@@ -1,0 +1,354 @@
+"""Cross-replica sharded weight update (ZeRO-style) for data-parallel axes.
+
+Implements "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training" (arXiv:2004.13336, PAPERS.md) for the runtime's
+train path: AdamW keeps two full-precision moments per parameter, and in
+plain data parallelism every dp replica holds a full copy of both — the
+optimizer state is usually the single largest resident HBM block after the
+params themselves (docs/roofline.md's memory model).  Sharding the moments
+and the weight-update computation across the dp axis cuts that to ~1/dp
+per device with no change to the math: each replica updates only its shard
+and the updated param shards are all-gathered back to the params' layout.
+
+Mechanically this is GSPMD layout annotation, not explicit collectives
+(the same recipe as train/step.py): gradients are constrained to the
+sharded layout before the inner optimizer runs (XLA turns the dp grad
+psum into a reduce-scatter), the moments it produces are constrained to
+stay sharded, and the updates are constrained back to the params' base
+layout (XLA inserts the all-gather).  Numerics are identical up to f32
+reduction order — tolerance story in docs/zero-sharding.md.
+
+The *plan* is the searchable artifact: one JSON-serializable record per
+param naming the dim the dp axis lands on (chosen by
+parallel/mesh.free_dim_partition_spec — largest free dim, ties toward the
+last), layered on top of whatever tp/fsdp layout the param already has.
+The controller stamps the strategy-level plan into the job status
+(api/types.zero_sharding_plan_doc) so the future AMP planner (ROADMAP
+item 3) can search over it.
+
+Moments are matched to params by tree-path **suffix + shape** — never
+shape alone: two different params can share a shape, but an optimizer
+state leaf that mirrors a param always carries the param's full tree path
+as the tail of its own (``.../0/mu/block_0/mlp/wi/kernel`` ends with
+``block_0/mlp/wi/kernel``).  Leaves that match no param path (step
+counts, empty states) replicate.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import AXIS_DP, axis_size, free_dim_partition_spec
+
+
+def _key_str(k) -> str:
+    """One tree-path element as a string (DictKey/GetAttrKey/SequenceKey)."""
+    for attr in ("key", "name", "idx"):
+        v = getattr(k, attr, None)
+        if v is not None:
+            return str(v)
+    return str(k)
+
+
+def path_parts(key_path) -> Tuple[str, ...]:
+    return tuple(_key_str(k) for k in key_path)
+
+
+def _spec_entries(spec: P, ndim: int) -> Tuple:
+    entries = tuple(spec)
+    return entries + (None,) * (ndim - len(entries))
+
+
+def _spec_to_json(spec: P, ndim: int) -> List:
+    out: List = []
+    for e in _spec_entries(spec, ndim):
+        out.append(list(e) if isinstance(e, tuple) else e)
+    return out
+
+
+def _spec_from_json(raw: Sequence) -> P:
+    entries = [tuple(e) if isinstance(e, list) else e for e in raw]
+    while entries and entries[-1] is None:  # normalize: P(None) == P() here
+        entries.pop()
+    return P(*entries)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanEntry:
+    path: Tuple[str, ...]  # param tree path, e.g. ("block_0", "mlp", "wi", "kernel")
+    shape: Tuple[int, ...]
+    dim: Optional[int]  # dim the dp axis shards, None = replicated over dp
+    base: P  # the param's own (tp/fsdp) layout
+    spec: P  # base + dp axis on `dim` — the optimizer-state layout
+
+
+@dataclasses.dataclass(frozen=True)
+class ZeroShardingPlan:
+    """Per-param weight-update sharding over one data-parallel mesh axis."""
+
+    axis: str
+    num_shards: int
+    entries: Tuple[PlanEntry, ...]
+    # The mesh the plan was built for — layout context, not part of the
+    # serialized plan (a restored plan gets its mesh from the caller).
+    # TrainState.apply_gradients uses it to pin the all-gather of updated
+    # params; compare=False so plans are equal across equivalent meshes.
+    mesh: Optional[Mesh] = dataclasses.field(default=None, compare=False)
+
+    def __post_init__(self):
+        # Longest path first so suffix matching prefers the most specific
+        # param when one param's path is a suffix of another's.
+        by_shape: Dict[Tuple[int, ...], List[PlanEntry]] = {}
+        for e in sorted(self.entries, key=lambda e: -len(e.path)):
+            by_shape.setdefault(e.shape, []).append(e)
+        object.__setattr__(self, "_by_shape", by_shape)
+
+    def match(self, parts: Sequence[str], shape) -> Optional[PlanEntry]:
+        return match_param_suffix(parts, shape, self._by_shape)
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "axis": self.axis,
+                "numShards": self.num_shards,
+                "params": [
+                    {
+                        "path": "/".join(e.path),
+                        "shape": list(e.shape),
+                        "dim": e.dim,
+                        "base": _spec_to_json(e.base, len(e.shape)),
+                    }
+                    for e in self.entries
+                ],
+            },
+            separators=(",", ":"),
+        )
+
+    @classmethod
+    def from_json(cls, text: str, mesh: Optional[Mesh] = None) -> "ZeroShardingPlan":
+        raw = json.loads(text)
+        axis, num = raw["axis"], int(raw["numShards"])
+        entries = []
+        for p in raw["params"]:
+            base = _spec_from_json(p["base"])
+            shape = tuple(int(d) for d in p["shape"])
+            dim = p["dim"]
+            if dim is None:
+                spec = base
+            else:
+                spec_entries = list(_spec_entries(base, len(shape)))
+                spec_entries[dim] = axis
+                spec = P(*spec_entries)
+            entries.append(
+                PlanEntry(
+                    path=tuple(p["path"].split("/")),
+                    shape=shape,
+                    dim=dim,
+                    base=base,
+                    spec=spec,
+                )
+            )
+        return cls(axis=axis, num_shards=num, entries=tuple(entries),
+                   mesh=mesh)
+
+
+def match_param_suffix(
+    parts: Sequence[str], shape, by_shape: Dict[Tuple[int, ...], List[PlanEntry]]
+) -> Optional[PlanEntry]:
+    """The moment↔param matching rule: an opt-state leaf belongs to the
+    param whose full tree path is a suffix of the leaf's path AND whose
+    shape matches — never shape alone.  Longest path wins on ambiguity."""
+    shape = tuple(shape) if shape is not None else ()
+    parts = tuple(parts)
+    for entry in by_shape.get(shape, ()):
+        n = len(entry.path)
+        if n and parts[-n:] == entry.path:
+            return entry
+    return None
+
+
+def _base_spec_of(leaf, base_spec) -> P:
+    if base_spec is not None:
+        if isinstance(base_spec, NamedSharding):
+            return base_spec.spec
+        return base_spec
+    sharding = getattr(leaf, "sharding", None)
+    if isinstance(sharding, NamedSharding):
+        return sharding.spec
+    return P()
+
+
+def build_zero_plan(
+    params,
+    mesh: Mesh,
+    axis: str = AXIS_DP,
+    base_specs=None,
+) -> ZeroShardingPlan:
+    """Choose the weight-update shard dim for every param.
+
+    `params` may be live arrays or `jax.eval_shape` structs.  `base_specs`
+    (a matching pytree of PartitionSpec/NamedSharding, e.g. from
+    tp_rules.make_param_shardings) names each param's existing layout; when
+    omitted it is read off live arrays' NamedShardings, else replicated.
+    The dp dim is the largest free dim divisible by the axis size, ties
+    toward the last (mesh.free_dim_partition_spec).
+    """
+    num = axis_size(mesh, axis)
+    base_flat = None
+    if base_specs is not None:
+        base_flat = jax.tree_util.tree_flatten(
+            base_specs, is_leaf=lambda x: isinstance(x, (P, NamedSharding))
+        )[0]
+    entries = []
+    for i, (key_path, leaf) in enumerate(
+        jax.tree_util.tree_flatten_with_path(params)[0]
+    ):
+        shape = tuple(getattr(leaf, "shape", ()))
+        base = _base_spec_of(leaf, base_flat[i] if base_flat is not None else None)
+        spec = free_dim_partition_spec(
+            shape, mesh, axis, base=base, prefer="largest"
+        )
+        dim = None
+        if spec is not base:
+            for d, (b, s) in enumerate(
+                zip(_spec_entries(base, len(shape)), _spec_entries(spec, len(shape)))
+            ):
+                if b != s:
+                    dim = d
+                    break
+        entries.append(
+            PlanEntry(path=path_parts(key_path), shape=shape, dim=dim,
+                      base=base, spec=spec)
+        )
+    return ZeroShardingPlan(axis=axis, num_shards=num, entries=tuple(entries),
+                            mesh=mesh)
+
+
+def base_placement_plan(params, mesh: Mesh, base_specs=None) -> ZeroShardingPlan:
+    """A degenerate plan (no dp axis, num_shards=1) whose entries carry only
+    the params' own layouts — the suffix+shape matcher train/step.py uses to
+    place *dense* optimizer state, so moments never match by shape alone."""
+    return build_zero_plan(params, mesh, axis="", base_specs=base_specs)
+
+
+# ---------------------------------------------------------------------------
+# Applying the plan to trees
+
+def _map_with_plan(tree, plan: ZeroShardingPlan, fn):
+    """fn(leaf, entry_or_None) over leaves, matching by path suffix+shape."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for key_path, leaf in flat:
+        entry = plan.match(path_parts(key_path), getattr(leaf, "shape", ()))
+        out.append(fn(leaf, entry))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def constrain_to_plan(tree, plan: ZeroShardingPlan, mesh: Mesh):
+    """Annotate matching leaves with their sharded (base+dp) layout — the
+    reduce-scatter point for gradients inside a jitted step."""
+    return _map_with_plan(
+        tree, plan,
+        lambda leaf, e: leaf if e is None else jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, e.spec)),
+    )
+
+
+def constrain_to_base(tree, plan: ZeroShardingPlan, mesh: Mesh):
+    """Annotate matching leaves with the params' own layout — the
+    all-gather point for the updated shards."""
+    return _map_with_plan(
+        tree, plan,
+        lambda leaf, e: leaf if e is None else jax.lax.with_sharding_constraint(
+            leaf, NamedSharding(mesh, e.base)),
+    )
+
+
+def place_opt_state(opt_state, plan: ZeroShardingPlan, mesh: Mesh):
+    """device_put moments onto their sharded layout (init-time, outside
+    jit); unmatched leaves (counts, empty states) replicate."""
+    repl = NamedSharding(mesh, P())
+    return _map_with_plan(
+        opt_state, plan,
+        lambda leaf, e: jax.device_put(
+            leaf, repl if e is None else NamedSharding(mesh, e.spec))
+        if hasattr(leaf, "shape") else leaf,
+    )
+
+
+def zero_shard_optimizer(
+    inner: optax.GradientTransformation,
+    plan: ZeroShardingPlan,
+    mesh: Mesh,
+) -> optax.GradientTransformation:
+    """Wrap `inner` so its state and update computation shard over the
+    plan's dp axis.
+
+    init: inner state with moments device_put onto the sharded layout.
+    update (inside the jitted train step): grads and params are viewed in
+    the sharded layout (reduce-scatter), the inner chain — clipping
+    included: arrays stay logically global, so the global norm is exact —
+    runs on shards, new moments stay sharded, and the updates are
+    constrained back to the params' base layout (all-gather).
+    """
+
+    def init(params):
+        return place_opt_state(inner.init(params), plan, mesh)
+
+    def update(grads, state, params=None, **extra):
+        g = constrain_to_plan(grads, plan, mesh)
+        p = constrain_to_plan(params, plan, mesh) if params is not None else None
+        updates, new_state = inner.update(g, state, p, **extra)
+        new_state = constrain_to_plan(new_state, plan, mesh)
+        return constrain_to_base(updates, plan, mesh), new_state
+
+    return optax.GradientTransformation(init, update)
+
+
+# ---------------------------------------------------------------------------
+# Accounting (the bench/roofline hook)
+
+def _shard_factor(entry: PlanEntry, plan: ZeroShardingPlan) -> int:
+    """How many ways this entry's moments are split.  With the plan's mesh
+    at hand the factor is exact over EVERY axis in the layout (the base
+    tp/fsdp axes shard the moments too — shard_train_state places them on
+    the full entry.spec); a mesh-less plan (from_json without a mesh) can
+    only count the dp axis it knows the width of."""
+    if plan.mesh is not None:
+        factor = 1
+        for e in entry.spec:
+            for a in (e if isinstance(e, tuple) else (e,)):
+                if a is not None:
+                    factor *= axis_size(plan.mesh, a)
+        return factor
+    return plan.num_shards if entry.dim is not None else 1
+
+
+def opt_state_bytes_per_device(
+    plan: Optional[ZeroShardingPlan], params, moments_per_param: int = 2
+) -> int:
+    """Resident optimizer-moment bytes per device under `plan` (None =
+    fully replicated moments).  AdamW keeps `moments_per_param`=2 (mu, nu)
+    leaves mirroring each param in the param dtype; each entry costs its
+    dense footprint divided by every mesh axis its layout shards over.
+
+    For the true dense baseline on a mesh with tp/fsdp axes (where even
+    plan-less moments follow the params' layout), pass
+    `base_placement_plan(params, mesh, base_specs)` instead of None —
+    plan=None prices pure replication."""
+    total = 0
+    for key_path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+        n = int(np.prod(shape, initial=1)) * dtype.itemsize * moments_per_param
+        entry = plan.match(path_parts(key_path), shape) if plan else None
+        if entry is not None:
+            n //= _shard_factor(entry, plan)
+        total += n
+    return total

@@ -40,6 +40,16 @@ def main(argv=None) -> int:
     parser.add_argument("--grad-accum", type=int, default=1,
                         help="microbatches per optimizer step (activation "
                              "memory / N, same update math)")
+    parser.add_argument("--zero-shard-weight-update", action="store_true",
+                        dest="zero_shard_weight_update", default=None,
+                        help="shard optimizer state + weight update over "
+                             "the dp mesh axis (ZeRO-style; ~1/dp optimizer "
+                             "HBM, same math). Defaults to the spec knob "
+                             "injected as TPUJOB_ZERO_SHARD_WEIGHT_UPDATE")
+    parser.add_argument("--no-zero-shard-weight-update", action="store_false",
+                        dest="zero_shard_weight_update", default=None,
+                        help="force the dense weight update even when the "
+                             "spec knob injected the env (A/B debugging)")
     parser.add_argument("--moe-experts", type=int, default=0,
                         help="enable MoE with this many experts (ep-sharded)")
     parser.add_argument("--moe-aux-weight", type=float, default=0.01)
@@ -187,20 +197,28 @@ def main(argv=None) -> int:
     from ..train.optim import lm_optimizer
 
     model = TransformerLM(cfg)
+    example = jnp.zeros((2, args.seq_len), jnp.int32)
+
+    # ZeRO weight-update sharding plan: flag wins, spec knob (injected env)
+    # is the default.  dp=1 has nothing to shard — announced, not silent.
+    from .runner import zero_plan_for_workload
+
+    zero_plan = zero_plan_for_workload(
+        ctx, model, example, mesh, enabled=args.zero_shard_weight_update)
     try:
         tx = lm_optimizer(
             args.lr, schedule=args.lr_schedule, warmup_steps=args.warmup_steps,
             total_steps=args.steps, weight_decay=args.weight_decay,
             grad_clip=args.grad_clip,
+            zero_plan=zero_plan, mesh=mesh if zero_plan is not None else None,
         )
     except ValueError as e:
         print(f"invalid optimizer config: {e}", flush=True)
         return 2
     state = create_train_state(
-        jax.random.PRNGKey(0), model, tx,
-        jnp.zeros((2, args.seq_len), jnp.int32),
+        jax.random.PRNGKey(0), model, tx, example, zero_plan=zero_plan,
     )
-    state = shard_train_state(state, mesh)
+    state = shard_train_state(state, mesh, zero_plan=zero_plan)
 
     mgr = None
     if args.checkpoint_dir:

@@ -54,12 +54,21 @@ def main(argv=None) -> int:
     mesh = ctx.build_mesh()
     model_cls = getattr(resnet_lib, f"ResNet{args.depth}")
     model = model_cls(num_classes=args.num_classes, dtype=jnp.bfloat16)
+    example = jnp.zeros(
+        (2, args.image_size, args.image_size, 3), jnp.bfloat16)
+    # Spec knob tpu.zeroShardWeightUpdate (injected env): shard the SGD
+    # momentum + weight update over dp (docs/zero-sharding.md).
+    from .runner import zero_plan_for_workload, zero_wrap_optimizer
+
+    zero_plan = zero_plan_for_workload(
+        ctx, model, example, mesh, init_kwargs={"train": True})
+    tx = zero_wrap_optimizer(
+        optax.sgd(args.lr, momentum=0.9), zero_plan, mesh)
     state = create_train_state(
-        jax.random.PRNGKey(0), model, optax.sgd(args.lr, momentum=0.9),
-        jnp.zeros((2, args.image_size, args.image_size, 3), jnp.bfloat16),
-        init_kwargs={"train": True},
+        jax.random.PRNGKey(0), model, tx, example,
+        init_kwargs={"train": True}, zero_plan=zero_plan,
     )
-    state = shard_train_state(state, mesh)
+    state = shard_train_state(state, mesh, zero_plan=zero_plan)
     step = make_train_step(
         classification_loss_fn(model.apply, has_batch_stats=True,
                                model_kwargs={"train": True}),

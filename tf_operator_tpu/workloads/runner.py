@@ -9,6 +9,7 @@ JAX-side equivalent: parse TF_CONFIG + the TPUJOB_* env into a WorkloadContext
 """
 from __future__ import annotations
 
+import functools
 import json
 import os
 from dataclasses import dataclass, field
@@ -104,6 +105,9 @@ class WorkloadContext:
     mesh_shape: Dict[str, int] = field(default_factory=dict)
     accelerator: str = ""
     slice_topology: str = ""
+    # spec tpu.zeroShardWeightUpdate → TPUJOB_ZERO_SHARD_WEIGHT_UPDATE → here;
+    # workloads treat it as the default for --zero-shard-weight-update.
+    zero_shard_weight_update: bool = False
 
     @property
     def is_coordinator(self) -> bool:
@@ -128,6 +132,9 @@ class WorkloadContext:
             mesh_shape=json.loads(mesh_raw) if mesh_raw else {},
             accelerator=env.get(constants.ENV_ACCELERATOR, ""),
             slice_topology=env.get(constants.ENV_SLICE_TOPOLOGY, ""),
+            zero_shard_weight_update=env.get(
+                constants.ENV_ZERO_SHARD_WEIGHT_UPDATE, ""
+            ).lower() in ("1", "true"),
         )
         # TF_CONFIG task block wins when present (parity with the reference's
         # contract: the task identity is authoritative there).
@@ -154,6 +161,55 @@ class WorkloadContext:
         from ..parallel.mesh import build_mesh
 
         return build_mesh(self.mesh_shape or None)
+
+
+def zero_plan_for_workload(ctx: "WorkloadContext", model, example, mesh, *,
+                           init_args=(), init_kwargs=None, enabled=None):
+    """The shared knob-honoring path for every workload that owns a train
+    loop: build the ZeRO weight-update sharding plan (train/zero.py) when
+    the spec knob (injected as TPUJOB_ZERO_SHARD_WEIGHT_UPDATE, surfaced on
+    ctx) or an explicit `enabled` asks for it AND the mesh has a real dp
+    axis; otherwise None.  The controller stamps status.zeroShardingPlan
+    for ANY replica group with the knob, so every train-path workload must
+    route through here — a knobbed job must never silently run dense.
+
+    Prints the chosen plan as one `zero_sharding_plan: {...}` line (the
+    log artifact AMP tooling lifts verbatim).  Param shapes come from
+    jax.eval_shape — no second real init."""
+    import jax
+
+    enabled = ctx.zero_shard_weight_update if enabled is None else enabled
+    if not enabled:
+        return None
+    from ..parallel.mesh import axis_size
+    from ..parallel.tp_rules import make_param_shardings
+    from ..train.zero import build_zero_plan
+
+    if axis_size(mesh, "dp") <= 1:
+        print("zero-shard-weight-update: dp axis size is 1, running dense",
+              flush=True)
+        return None
+    # init_kwargs stay static (partial, not traced): flax branches on
+    # bools like train=True, which an abstract value would concretize-error
+    shapes = jax.eval_shape(
+        functools.partial(model.init, **(init_kwargs or {})),
+        jax.random.PRNGKey(0), example, *init_args)["params"]
+    plan = build_zero_plan(
+        shapes, mesh, base_specs=make_param_shardings(shapes, mesh))
+    print(f"zero_sharding_plan: {plan.to_json()}", flush=True)
+    return plan
+
+
+def zero_wrap_optimizer(tx, plan, mesh):
+    """The one shared wrap site for workloads: ZeRO-shard `tx` under
+    `plan`, or return it unchanged when the plan is None (knob off /
+    dense mesh).  lm goes through train/optim.lm_optimizer instead, which
+    keeps clipping inside the wrapper."""
+    if plan is None:
+        return tx
+    from ..train.zero import zero_shard_optimizer
+
+    return zero_shard_optimizer(tx, plan, mesh)
 
 
 def runconfig_from_env(env: Optional[Dict[str, str]] = None) -> Dict[str, object]:

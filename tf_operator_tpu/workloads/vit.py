@@ -67,11 +67,18 @@ def main(argv=None) -> int:
     )
     model = ViT(cfg, num_classes=args.num_classes,
                 patch_size=args.patch_size)
+    example = jnp.zeros(
+        (2, args.image_size, args.image_size, 3), jnp.bfloat16)
+    # Spec knob tpu.zeroShardWeightUpdate: dp-shard the AdamW moments +
+    # weight update (docs/zero-sharding.md).
+    from .runner import zero_plan_for_workload, zero_wrap_optimizer
+
+    zero_plan = zero_plan_for_workload(ctx, model, example, mesh)
+    tx = zero_wrap_optimizer(optax.adamw(args.lr), zero_plan, mesh)
     state = create_train_state(
-        jax.random.PRNGKey(0), model, optax.adamw(args.lr),
-        jnp.zeros((2, args.image_size, args.image_size, 3), jnp.bfloat16),
+        jax.random.PRNGKey(0), model, tx, example, zero_plan=zero_plan,
     )
-    state = shard_train_state(state, mesh)
+    state = shard_train_state(state, mesh, zero_plan=zero_plan)
     step = make_train_step(classification_loss_fn(model.apply))
 
     rng = np.random.RandomState(ctx.replica_index)
