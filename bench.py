@@ -64,7 +64,11 @@ queue-latency p99 — docs/federation.md; BENCH_K8S_SOAK_10K_JOBS scales
 the job count for smoke runs).  BENCH_ZERO=1 adds the ZeRO weight-update
 sharding A/B arm (lm_opt_state_bytes_per_device + zero on/off tokens/sec
 at dp>=2; BENCH_ZERO_DEVICES virtual devices on the CPU fallback,
-default 4 — docs/zero-sharding.md).
+default 4 — docs/zero-sharding.md).  BENCH_ELASTIC=1 adds the elastic
+resize arm (time-to-recover for a preemption -> dp/2 restore plus the
+goodput the shrunken mesh retains vs kill-and-restart's 0.0;
+BENCH_ELASTIC_DEVICES virtual devices on the CPU fallback, default 4 —
+docs/elasticity.md).
 """
 from __future__ import annotations
 
@@ -350,6 +354,35 @@ def _zero_ab(stages, platform):
     return parsed if ok else None
 
 
+def _elastic_ab(stages, platform):
+    """Elastic resize A/B (docs/elasticity.md), env-gated BENCH_ELASTIC=1:
+    time-to-recover (preemption -> dp/2 restore -> first optimizer step,
+    checkpoint re-shard and recompile included) and the goodput the shrunken
+    mesh retains vs full width.  The kill-and-restart baseline retains 0.0
+    while the slice is gone — that constant IS the comparison, no sleep
+    theater needed.  On the CPU fallback the child forces
+    BENCH_ELASTIC_DEVICES virtual devices (default 4) in its own process."""
+    if os.environ.get("BENCH_ELASTIC") != "1":
+        return None
+    env = {}
+    if platform is None:
+        env["TPUJOB_FORCE_PLATFORM"] = "cpu"
+        env["BENCH_ELASTIC_DEVICES"] = os.environ.get(
+            "BENCH_ELASTIC_DEVICES", "4")
+    t0 = time.time()
+    rc, out, err = _run(
+        [sys.executable, os.path.abspath(__file__), "--child-elastic"],
+        env, CHILD_TIMEOUT,
+    )
+    parsed = _last_json(out)
+    ok = parsed is not None and "error" not in (parsed or {})
+    stages.append({"stage": "elastic_ab", "rc": rc,
+                   "sec": round(time.time() - t0, 1), "ok": ok,
+                   **({} if ok else
+                      {"err": (parsed or {}).get("error") or err[-300:]})})
+    return parsed if ok else None
+
+
 def _native(stages):
     if os.environ.get("BENCH_SKIP_NATIVE"):
         return None
@@ -427,11 +460,15 @@ def orchestrate() -> None:
         stages.append({"stage": "orchestrator", "err": repr(e)[:300]})
     if not attention_done:
         _run_attention()
-    cp = native = zero = None
+    cp = native = zero = elastic = None
     try:
         zero = _zero_ab(stages, platform)
     except Exception as e:  # noqa: BLE001
         stages.append({"stage": "zero_ab", "err": repr(e)[:300]})
+    try:
+        elastic = _elastic_ab(stages, platform)
+    except Exception as e:  # noqa: BLE001
+        stages.append({"stage": "elastic_ab", "err": repr(e)[:300]})
     try:
         cp = _control_plane(stages)
     except Exception as e:  # noqa: BLE001
@@ -464,6 +501,8 @@ def orchestrate() -> None:
         headline["native"] = native
     if zero:
         headline["zero"] = zero
+    if elastic:
+        headline["elastic"] = elastic
     headline["stages"] = stages
     print(json.dumps(_compact_summary(headline)))
 
@@ -913,6 +952,134 @@ def child_zero() -> None:
         "zero_on_tokens_per_sec": round(statistics.median(on_w), 2),
         "zero_off_tokens_per_sec": round(statistics.median(off_w), 2),
         "zero_on_vs_off": round(statistics.median(ratios), 4),
+    }))
+
+
+def child_elastic() -> None:
+    """The elastic-resize recovery arc, measured: train the lm model at full
+    dp width with a ZeRO plan, checkpoint, lose half the mesh, and time
+    preemption -> restore-onto-dp/2 -> first optimizer step (the worker-side
+    cost of one Resizing pass).  Then the steady-state A/B: tokens/sec on
+    the shrunken mesh vs full width = the goodput an elastic job retains
+    while kill-and-restart retains zero."""
+    import tempfile
+
+    ndev_req = int(os.environ.get("BENCH_ELASTIC_DEVICES", "0"))
+    if ndev_req > 1:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={ndev_req}"
+            ).strip()
+    from tf_operator_tpu.workloads.runner import apply_forced_platform
+
+    apply_forced_platform()
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tf_operator_tpu.models.transformer import (
+        TransformerConfig, TransformerLM,
+    )
+    from tf_operator_tpu.parallel.mesh import build_mesh
+    from tf_operator_tpu.parallel.tp_rules import make_param_shardings
+    from tf_operator_tpu.train.checkpoint import CheckpointManager
+    from tf_operator_tpu.train.optim import lm_optimizer
+    from tf_operator_tpu.train.state import create_train_state
+    from tf_operator_tpu.train.step import (
+        lm_loss_fn, make_train_step, shard_batch, shard_train_state,
+    )
+    from tf_operator_tpu.train.zero import build_zero_plan
+
+    devices = jax.devices()
+    full = len(devices) - len(devices) % 2
+    if full < 4:
+        print(json.dumps({"metric": "lm_elastic_ab",
+                          "skipped": f"{len(devices)} devices < 4 "
+                                     "(no mesh to halve)"}))
+        return
+    shrunk = full // 2
+    steps = int(os.environ.get("BENCH_STEPS", "6"))
+    windows = max(3, int(os.environ.get("BENCH_WINDOWS", "3")))
+    seq = int(os.environ.get("BENCH_SEQ", "256"))
+    batch_size = int(os.environ.get("BENCH_BATCH", str(2 * full)))
+    batch_size = max(full, batch_size // full * full)  # dp must divide batch
+    cfg = TransformerConfig(
+        vocab_size=int(os.environ.get("BENCH_LM_VOCAB", "8192")),
+        num_layers=int(os.environ.get("BENCH_LM_LAYERS", "2")),
+        num_heads=int(os.environ.get("BENCH_LM_HEADS", "4")),
+        d_model=int(os.environ.get("BENCH_LM_DMODEL", "256")),
+        d_ff=int(os.environ.get("BENCH_LM_DFF", "1024")),
+        max_len=seq, causal=True,
+    )
+    model = TransformerLM(cfg)
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (batch_size, seq + 1)), jnp.int32)
+    example = tokens[:2, :-1]
+    shapes = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0), example)["params"]
+    raw = make_train_step(lm_loss_fn(model.apply), jit=False)
+
+    def arm(dp, devs):
+        mesh = build_mesh({"dp": dp}, devices=devs)
+        plan = build_zero_plan(
+            shapes, mesh, base_specs=make_param_shardings(shapes, mesh))
+        tx = lm_optimizer(3e-4, zero_plan=plan, mesh=mesh)
+        state = create_train_state(
+            jax.random.PRNGKey(0), model, tx, example, zero_plan=plan)
+        state = shard_train_state(state, mesh, zero_plan=plan)
+        batch = shard_batch({"tokens": tokens}, mesh)
+        return mesh, plan, state, batch
+
+    per_step = batch_size * seq
+    mesh4, plan4, state4, batch4 = arm(full, devices[:full])
+    full_timer = _window_timer(raw, state4, batch4, steps)
+    full_w = [full_timer() * per_step for _ in range(windows)]
+
+    # a few real optimizer steps before the save, so the restore below
+    # demonstrably continues (resume_step > 0) instead of restoring init
+    step4 = jax.jit(raw)
+    for _ in range(2):
+        state4, _m = step4(state4, batch4)
+    ckpt_dir = tempfile.mkdtemp(prefix="bench-elastic-")
+    mgr = CheckpointManager(ckpt_dir)
+    mgr.save(state4.replace(zero_plan=plan4))
+    mgr.close()
+
+    # --- preemption: half the mesh is gone.  Everything from here to the
+    # first completed optimizer step is the recovery path a Resizing pass
+    # pays on the worker side: rebuild at dp/2, restore (sidecar re-shard),
+    # recompile, step once.
+    t0 = time.perf_counter()
+    mesh2, plan2, template, batch2 = arm(shrunk, devices[:shrunk])
+    mgr2 = CheckpointManager(ckpt_dir)
+    restored = mgr2.restore(template)
+    mgr2.close()
+    step2 = jax.jit(raw)
+    recovered, metrics = step2(restored, batch2)
+    jax.device_get(metrics["loss"])
+    time_to_recover = time.perf_counter() - t0
+
+    shrunk_timer = _window_timer(raw, recovered, batch2, steps)
+    shrunk_w = [shrunk_timer() * per_step for _ in range(windows)]
+    full_rate = statistics.median(full_w)
+    shrunk_rate = statistics.median(shrunk_w)
+    print(json.dumps({
+        "metric": "lm_elastic_ab",
+        "dp_full": full,
+        "dp_shrunk": shrunk,
+        "resume_step": int(jax.device_get(restored.step)),
+        "full_tokens_per_sec": round(full_rate, 2),
+        "shrunk_tokens_per_sec": round(shrunk_rate, 2),
+        "time_to_recover_sec": round(time_to_recover, 3),
+        # goodput while the slice is gone: the resized job keeps training
+        # at the shrunken rate; a kill-and-restart job trains at zero until
+        # capacity returns (definitional, not simulated)
+        "goodput_retained": round(shrunk_rate / full_rate, 4),
+        "goodput_retained_kill_restart": 0.0,
     }))
 
 
@@ -1433,6 +1600,8 @@ if __name__ == "__main__":
         child_throughput()
     elif "--child-zero" in sys.argv:
         child_zero()
+    elif "--child-elastic" in sys.argv:
+        child_elastic()
     elif "--child-attention" in sys.argv:
         child_attention()
     elif "--child-control-plane" in sys.argv:

@@ -1,0 +1,157 @@
+"""Elastic training-side chaos: the data plane half of docs/elasticity.md.
+
+test_elastic_resize.py pins the control-plane arc (preemption -> Resizing ->
+shrink -> repair -> re-grow, zero Failed transitions) on the in-memory stack;
+these tests pin what the WORKERS must guarantee across that arc, with real
+`workloads.lm` subprocesses on the CPU virtual-device mesh:
+
+  - a dp=4 zero_plan checkpoint restores onto the dp=2 mesh a shrink leaves
+    behind (the sidecar re-shard path), the step counter stays monotonic
+    across shrink AND re-grow, and the loss keeps improving — the job
+    resized, it did not start over;
+  - a whole-slice preemption that lands MID-checkpoint-save (SIGKILL, no
+    shutdown grace) never leaves a torn latest checkpoint: the next life
+    restores a complete step and finishes.
+
+Both are slow-tier (subprocess jax imports + compiles); the fast tier keeps
+the reshard math pinned in test_zero_sharding.py TestCheckpointReshard.
+"""
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tf_operator_tpu.api import constants
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+LM_ARGS = [
+    "--batch", "8", "--seq-len", "32", "--vocab", "256",
+    "--layers", "1", "--d-model", "64",
+    "--zero-shard-weight-update",
+]
+
+
+def lm_env(dp, physical, generation):
+    """The env the controller would inject for one elastic lm worker:
+    a dp-wide mesh plus the virtual/physical mapping for this resize
+    generation (topology.py gen_tpu_env)."""
+    env = dict(os.environ)
+    env["TPUJOB_FORCE_PLATFORM"] = "cpu"
+    # exactly dp virtual devices: build_mesh requires the axis product to
+    # consume the whole host, so the shrunken life really runs on fewer
+    # devices (strip any inherited fan-out flag first)
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={dp}").strip()
+    env[constants.ENV_MESH_SHAPE] = json.dumps({"dp": dp})
+    env[constants.ENV_VIRTUAL_REPLICAS] = "4"
+    env[constants.ENV_PHYSICAL_REPLICAS] = str(physical)
+    env[constants.ENV_ELASTIC_GENERATION] = str(generation)
+    return env
+
+
+def run_lm(ckpt_dir, steps, dp, physical, generation, checkpoint_every=5):
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.workloads.lm",
+         "--steps", str(steps), "--checkpoint-dir", str(ckpt_dir),
+         "--checkpoint-every", str(checkpoint_every), *LM_ARGS],
+        env=lm_env(dp, physical, generation),
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc.stdout
+
+
+def losses_by_step(out):
+    return {int(m.group(1)): float(m.group(2))
+            for m in re.finditer(r"step (\d+) loss ([\d.]+)", out)}
+
+
+def test_shrink_regrow_checkpoint_continuation(tmp_path):
+    """dp=4 -> preempted -> dp=2 -> repaired -> dp=4, one checkpoint dir.
+
+    Each life is what the controller launches after a resize pass: same
+    virtual width, new physical mesh, next generation.  The zero_plan
+    sidecar written at dp=4 must re-shard onto dp=2 and back; the step
+    counter and the loss must carry across both resizes."""
+    ckpt = tmp_path / "ckpt"
+
+    first = run_lm(ckpt, steps=10, dp=4, physical=4, generation=0)
+    assert "elastic mapping: virtual=4 physical=4 generation=0" in first
+    assert "resumed from step" not in first
+
+    # life 2: the fabric took a slice, the controller resized to P=2 and
+    # re-launched the gang on the smaller mesh
+    second = run_lm(ckpt, steps=20, dp=2, physical=2, generation=1)
+    assert "elastic mapping: virtual=4 physical=2 generation=1" in second
+    resumed = re.search(r"resumed from step (\d+)", second)
+    assert resumed and int(resumed.group(1)) == 10
+
+    # life 3: repair re-grew the job to full width
+    third = run_lm(ckpt, steps=30, dp=4, physical=4, generation=2)
+    assert "elastic mapping: virtual=4 physical=4 generation=2" in third
+    resumed = re.search(r"resumed from step (\d+)", third)
+    assert resumed and int(resumed.group(1)) == 20
+
+    # step counter monotonic across the whole arc: each life trains only
+    # the steps after its restore point, none re-run, none skipped
+    steps = sorted({**losses_by_step(first), **losses_by_step(second),
+                    **losses_by_step(third)})
+    assert steps == [0, 10, 20]
+    losses = {**losses_by_step(first), **losses_by_step(second),
+              **losses_by_step(third)}
+    # the loss trajectory continues through both resizes (same synthetic
+    # stream, tiny model: by step 20 it must be well below the step-0
+    # cross-entropy, not reset to it)
+    assert losses[20] < losses[0], losses
+
+
+def test_preemption_mid_checkpoint_save_never_tears(tmp_path):
+    """SIGKILL the worker while orbax is writing (checkpoint-every=1 keeps
+    a save in flight almost continuously): whatever instant the kill lands,
+    the next life must restore a COMPLETE checkpoint — a torn step must
+    never become latest_step (the commit-marker contract the Resizing
+    restore path depends on)."""
+    ckpt = tmp_path / "ckpt"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tf_operator_tpu.workloads.lm",
+         "--steps", "200", "--checkpoint-dir", str(ckpt),
+         "--checkpoint-every", "1", *LM_ARGS],
+        env=lm_env(4, 4, 0),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        # wait for the first committed checkpoint, then preempt hard while
+        # later saves are in flight
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            if ckpt.exists() and any(p.name.isdigit() for p in ckpt.iterdir()):
+                break
+            if proc.poll() is not None:
+                pytest.fail("worker exited before first checkpoint:\n"
+                            + proc.stdout.read())
+            time.sleep(0.05)
+        else:
+            pytest.fail("no checkpoint appeared within 300s")
+        time.sleep(0.2)  # let a few more saves start
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
+
+    # second life, smaller mesh (the preemption shrank the job): restore
+    # must find a complete step and run to completion
+    out = run_lm(ckpt, steps=40, dp=2, physical=2, generation=1)
+    resumed = re.search(r"resumed from step (\d+)", out)
+    assert resumed, out
+    assert 1 <= int(resumed.group(1)) <= 200
+    assert "done" in out

@@ -1,0 +1,444 @@
+"""Elastic virtual-replica jobs: survive slice preemption by resizing.
+
+The VirtualFlow-style indirection (docs/elasticity.md): spec.replicas is the
+FIXED virtual width V of a group; the physical width P floats inside
+[minReplicas, maxReplicas] and virtual replica j runs on physical replica
+j % P.  These tests pin the control-plane arc end to end on the in-memory
+stack: initial mapping stamp, preemption shrink through the Resizing
+condition (zero Failed transitions), re-grow on repair, spec resize, the
+backoff exemption for preemption-driven restarts, and the slice provider's
+repair idempotency (satellites 1-2 of the elastic ISSUE).
+"""
+import pytest
+
+from tf_operator_tpu.api import constants
+from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.serialization import job_from_dict, job_to_dict
+from tf_operator_tpu.api.types import (
+    ElasticPolicy,
+    JobConditionType,
+    ReplicaType,
+    RestartPolicy,
+    TPUTopology,
+    effective_replicas,
+    elastic_status_doc,
+)
+from tf_operator_tpu.controller.topology import gen_tpu_env
+from tf_operator_tpu.runtime.cluster import InMemoryCluster
+from tf_operator_tpu.runtime.scheduler import GangScheduler
+from tf_operator_tpu.runtime.slices import FakeSliceProvider, SliceState
+from tf_operator_tpu.utils import metrics
+
+from testutil import new_tpujob
+
+ACCEL = "v5e-4"
+TOPOLOGY = "2x2"  # 4 chips = 1 host: one slice per physical replica
+
+
+def make_stack(slice_count):
+    from tf_operator_tpu.controller.controller import TPUJobController
+    from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+
+    cluster = InMemoryCluster()
+    controller = TPUJobController(
+        cluster, config=ReconcilerConfig(enable_gang_scheduling=True)
+    )
+    provider = FakeSliceProvider({(ACCEL, TOPOLOGY): slice_count})
+    scheduler = GangScheduler(cluster, slice_provider=provider)
+    # Mirrors server.py wiring: the controller reaches the provider through
+    # its gang_scheduler attribute for elastic grow capacity checks.
+    controller.gang_scheduler = scheduler
+    return cluster, controller, provider, scheduler
+
+
+def elastic_job(name, virtual, lo, hi):
+    job = new_tpujob(worker=virtual, name=name,
+                     restart_policy=RestartPolicy.EXIT_CODE)
+    rspec = job.spec.replica_specs[ReplicaType.WORKER]
+    rspec.tpu = TPUTopology(accelerator=ACCEL, topology=TOPOLOGY)
+    rspec.elastic = ElasticPolicy(min_replicas=lo, max_replicas=hi)
+    set_defaults(job)
+    return job
+
+
+def job_pods(cluster, name):
+    return sorted(
+        cluster.list_pods(selector={"job-name": name}),
+        key=lambda p: int(p.metadata.labels[constants.LABEL_REPLICA_INDEX]),
+    )
+
+
+def bound_pods(cluster, name):
+    return [
+        p for p in job_pods(cluster, name)
+        if p.metadata.annotations.get("tpu-operator.dev/bound") == "true"
+    ]
+
+
+def stored(cluster, name):
+    return cluster.get_job("default", name)
+
+
+def condition_map(job):
+    return {c.type: c for c in job.status.conditions}
+
+
+def worker_group(job):
+    return (job.status.elastic or {})["groups"]["Worker"]
+
+
+def run_all(cluster, name):
+    for pod in job_pods(cluster, name):
+        if pod.status.phase == PodPhase.PENDING:
+            cluster.set_pod_phase("default", pod.metadata.name,
+                                  PodPhase.RUNNING)
+
+
+class TestMappingStamp:
+    def test_initial_doc_and_admission(self):
+        cluster, controller, provider, _ = make_stack(4)
+        job = elastic_job("ela-init", virtual=4, lo=2, hi=4)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+
+        assert len(bound_pods(cluster, "ela-init")) == 4
+        doc = stored(cluster, "ela-init").status.elastic
+        assert doc["generation"] == 0
+        group = doc["groups"]["Worker"]
+        assert group["virtual"] == 4 and group["physical"] == 4
+        assert group["min"] == 2 and group["max"] == 4
+        assert group["assignment"] == {"0": 0, "1": 1, "2": 2, "3": 3}
+        assert doc["history"] == []
+
+    def test_elastic_env_emitted(self):
+        job = elastic_job("ela-env", virtual=4, lo=2, hi=4)
+        env = gen_tpu_env(job, ReplicaType.WORKER, 1)
+        assert env[constants.ENV_VIRTUAL_REPLICAS] == "4"
+        assert env[constants.ENV_PHYSICAL_REPLICAS] == "4"
+        assert env[constants.ENV_ELASTIC_GENERATION] == "0"
+        # shrink the doc: TF_CONFIG-side world and env follow the physical
+        # width while the virtual width stays put
+        job.status.elastic = elastic_status_doc(job)
+        job.status.elastic["groups"]["Worker"]["physical"] = 2
+        env = gen_tpu_env(job, ReplicaType.WORKER, 1)
+        assert env[constants.ENV_PHYSICAL_REPLICAS] == "2"
+        assert env[constants.ENV_VIRTUAL_REPLICAS] == "4"
+        assert effective_replicas(job, ReplicaType.WORKER) == 2
+
+    def test_non_elastic_jobs_carry_no_doc(self):
+        cluster, controller, _, _ = make_stack(4)
+        job = new_tpujob(worker=2, name="plain-a")
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert stored(cluster, "plain-a").status.elastic is None
+
+
+class TestPreemptionShrink:
+    def test_whole_arc_shrink_then_regrow(self):
+        """The acceptance arc: preemption -> Resizing -> smaller gang runs,
+        repair -> Resizing -> full-width gang runs; zero Failed transitions
+        and a complete resize history throughout."""
+        cluster, controller, provider, _ = make_stack(4)
+        resize0 = metrics.resizes.labels("SlicePreempted").get()
+        job = elastic_job("ela-arc", virtual=4, lo=2, hi=4)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        run_all(cluster, "ela-arc")
+        controller.sync_job(job.key())
+        assert JobConditionType.RUNNING in condition_map(stored(cluster, "ela-arc"))
+
+        victim = job_pods(cluster, "ela-arc")[3]
+        slice_id = victim.metadata.annotations[constants.ANNOTATION_SLICE_ID]
+        provider.inject_preemption(slice_id)
+        controller.sync_job(job.key())
+
+        now = stored(cluster, "ela-arc")
+        conds = condition_map(now)
+        # shrank instead of dying: Resizing owns the pass, Failed never set
+        assert JobConditionType.FAILED not in conds
+        assert conds[JobConditionType.RESIZING].status is True
+        assert JobConditionType.RUNNING not in conds
+        doc = now.status.elastic
+        assert doc["generation"] == 1
+        group = doc["groups"]["Worker"]
+        assert group["physical"] == 3 and group["virtual"] == 4
+        # every virtual replica still mapped, none doubled
+        assert group["assignment"] == {"0": 0, "1": 1, "2": 2, "3": 0}
+        (entry,) = doc["history"]
+        assert entry["reason"] == "SlicePreempted"
+        assert (entry["from"], entry["to"]) == (4, 3)
+        assert metrics.resizes.labels("SlicePreempted").get() == resize0 + 1
+
+        # the resized gang is recreated at width 3 in the SAME pass and
+        # admitted on the surviving slices
+        pods = job_pods(cluster, "ela-arc")
+        assert len(pods) == 3
+        assert len(bound_pods(cluster, "ela-arc")) == 3
+
+        # once the resized gang runs, Resizing retracts to False in place
+        run_all(cluster, "ela-arc")
+        controller.sync_job(job.key())
+        conds = condition_map(stored(cluster, "ela-arc"))
+        assert conds[JobConditionType.RUNNING].status is True
+        assert conds[JobConditionType.RESIZING].status is False
+        assert conds[JobConditionType.RESIZING].reason == "RunningResized"
+
+        # repair: capacity returns, the group grows back to max
+        provider.repair(slice_id)
+        controller.sync_job(job.key())
+        now = stored(cluster, "ela-arc")
+        doc = now.status.elastic
+        assert doc["generation"] == 2
+        assert doc["groups"]["Worker"]["physical"] == 4
+        assert [e["reason"] for e in doc["history"]] == [
+            "SlicePreempted", "SliceRepaired"
+        ]
+        assert len(job_pods(cluster, "ela-arc")) == 4
+        assert len(bound_pods(cluster, "ela-arc")) == 4
+        run_all(cluster, "ela-arc")
+        controller.sync_job(job.key())
+        conds = condition_map(stored(cluster, "ela-arc"))
+        assert conds[JobConditionType.RUNNING].status is True
+        assert conds[JobConditionType.RESIZING].status is False
+        assert JobConditionType.FAILED not in conds
+
+    def test_below_floor_holds_width_and_waits_for_repair(self):
+        """lost pods would take P below minReplicas: no resize — the normal
+        retryable-restart path recreates the pods, which pend until the
+        fabric repairs the slice.  Still zero Failed transitions."""
+        cluster, controller, provider, _ = make_stack(2)
+        job = elastic_job("ela-floor", virtual=2, lo=2, hi=2)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert len(bound_pods(cluster, "ela-floor")) == 2
+
+        victim = job_pods(cluster, "ela-floor")[1]
+        slice_id = victim.metadata.annotations[constants.ANNOTATION_SLICE_ID]
+        provider.inject_preemption(slice_id)
+        controller.sync_job(job.key())
+        now = stored(cluster, "ela-floor")
+        conds = condition_map(now)
+        assert JobConditionType.FAILED not in conds
+        assert JobConditionType.RESIZING not in conds
+        assert conds[JobConditionType.RESTARTING].status is True
+        assert now.status.elastic["generation"] == 0
+        assert now.status.elastic["groups"]["Worker"]["physical"] == 2
+
+        controller.sync_job(job.key())  # recreate deleted victim
+        pods = job_pods(cluster, "ela-floor")
+        assert len(pods) == 2
+        provider.repair(slice_id)
+        assert len(bound_pods(cluster, "ela-floor")) == 2
+        controller.sync_job(job.key())
+        assert stored(cluster, "ela-floor").status.elastic["generation"] == 0
+
+    def test_status_write_coalesced_per_resize(self):
+        """A resize pass (condition + doc + replica churn) lands as exactly
+        one status PUT through the coalescing writer."""
+        cluster, controller, provider, _ = make_stack(4)
+        job = elastic_job("ela-wr", virtual=4, lo=2, hi=4)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        writes0 = controller.status_writer.counters()["writes"]
+        slice_id = job_pods(cluster, "ela-wr")[0].metadata.annotations[
+            constants.ANNOTATION_SLICE_ID
+        ]
+        provider.inject_preemption(slice_id)
+        controller.sync_job(job.key())
+        assert controller.status_writer.counters()["writes"] == writes0 + 1
+
+
+class TestSpecResize:
+    def test_spec_resize_restamps_mapping(self):
+        cluster, controller, provider, _ = make_stack(4)
+        job = elastic_job("ela-spec", virtual=4, lo=1, hi=4)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert len(bound_pods(cluster, "ela-spec")) == 4
+
+        live = stored(cluster, "ela-spec")
+        live.spec.replica_specs[ReplicaType.WORKER].elastic.max_replicas = 2
+        cluster.update_job(live)
+        controller.sync_job(job.key())
+
+        now = stored(cluster, "ela-spec")
+        doc = now.status.elastic
+        assert doc["generation"] == 1
+        assert doc["groups"]["Worker"]["physical"] == 2
+        (entry,) = doc["history"]
+        assert entry["reason"] == "SpecResized"
+        assert (entry["from"], entry["to"]) == (4, 2)
+        assert len(job_pods(cluster, "ela-spec")) == 2
+        assert len(bound_pods(cluster, "ela-spec")) == 2
+        assert condition_map(now)[JobConditionType.RESIZING].status is True
+
+    def test_podgroup_min_member_follows_physical_width(self):
+        cluster, controller, provider, _ = make_stack(4)
+        job = elastic_job("ela-pg", virtual=4, lo=1, hi=4)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        assert cluster.get_podgroup("default", "ela-pg").min_member == 4
+        live = stored(cluster, "ela-pg")
+        live.spec.replica_specs[ReplicaType.WORKER].elastic.max_replicas = 2
+        cluster.update_job(live)
+        controller.sync_job(job.key())
+        assert cluster.get_podgroup("default", "ela-pg").min_member == 2
+
+
+class TestBackoffExemption:
+    """Satellite 1: preemption-driven restarts never consume backoffLimit."""
+
+    def _reconciler(self):
+        from tf_operator_tpu.controller.controller import TPUJobController
+
+        return TPUJobController(InMemoryCluster()).reconciler
+
+    def test_preemption_exit_codes_do_not_count(self):
+        from testutil import new_pod
+
+        rec = self._reconciler()
+        job = new_tpujob(worker=1, restart_policy=RestartPolicy.ALWAYS)
+        job.spec.run_policy.backoff_limit = 0  # any counted restart fails
+        pod = new_pod(job, ReplicaType.WORKER, 0, PodPhase.RUNNING,
+                      exit_code=143, restart_count=3)
+        assert rec.past_backoff_limit(job, [pod]) is False
+
+    def test_slice_preempted_reason_does_not_count(self):
+        from testutil import new_pod
+
+        rec = self._reconciler()
+        job = new_tpujob(worker=1, restart_policy=RestartPolicy.ALWAYS)
+        job.spec.run_policy.backoff_limit = 0
+        pod = new_pod(job, ReplicaType.WORKER, 0, PodPhase.RUNNING,
+                      restart_count=5)
+        pod.status.reason = "SlicePreempted"
+        assert rec.past_backoff_limit(job, [pod]) is False
+
+    def test_workload_crashes_still_count(self):
+        from testutil import new_pod
+
+        rec = self._reconciler()
+        job = new_tpujob(worker=1, restart_policy=RestartPolicy.ALWAYS)
+        job.spec.run_policy.backoff_limit = 0
+        pod = new_pod(job, ReplicaType.WORKER, 0, PodPhase.RUNNING,
+                      exit_code=1, restart_count=1)
+        assert rec.past_backoff_limit(job, [pod]) is True
+
+    def test_preempted_elastic_job_survives_backoff_limit_zero(self):
+        """End to end: backoffLimit=0 plus a preemption shrink — the job
+        resizes and keeps running instead of tripping the limit."""
+        cluster, controller, provider, _ = make_stack(4)
+        job = elastic_job("ela-bo", virtual=4, lo=2, hi=4)
+        job.spec.run_policy.backoff_limit = 0
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        slice_id = job_pods(cluster, "ela-bo")[0].metadata.annotations[
+            constants.ANNOTATION_SLICE_ID
+        ]
+        provider.inject_preemption(slice_id)
+        controller.sync_job(job.key())
+        conds = condition_map(stored(cluster, "ela-bo"))
+        assert JobConditionType.FAILED not in conds
+        assert conds[JobConditionType.RESIZING].status is True
+
+
+class TestRepairIdempotency:
+    """Satellite 2: stale/duplicate repair notices are harmless no-ops."""
+
+    def test_repair_of_never_preempted_slice_is_noop(self):
+        provider = FakeSliceProvider({(ACCEL, TOPOLOGY): 1})
+        events = []
+        provider.watch(lambda s, e: events.append(e))
+        (s,) = provider.allocate("g1", ACCEL, TOPOLOGY, 1)
+        out = provider.repair(s.id)
+        assert out is s
+        assert s.state == SliceState.ALLOCATED and s.holder == "g1"
+        assert events == []  # no spurious "repaired" -> no double-grow
+
+    def test_double_repair_fires_single_event(self):
+        provider = FakeSliceProvider({(ACCEL, TOPOLOGY): 1})
+        events = []
+        provider.watch(lambda s, e: events.append(e))
+        (s,) = provider.allocate("g1", ACCEL, TOPOLOGY, 1)
+        provider.inject_preemption(s.id)
+        provider.repair(s.id)
+        provider.repair(s.id)
+        assert events == ["preempted", "repaired"]
+
+    def test_unknown_slice_ids_ignored(self):
+        provider = FakeSliceProvider({(ACCEL, TOPOLOGY): 1})
+        assert provider.repair("no-such-slice") is None
+        assert provider.inject_preemption("no-such-slice") is None
+
+    def test_repair_racing_release_never_resurrects_holder(self):
+        """repair() after the shrink's release() must leave the slice FREE
+        with no stale holder, in either interleaving order."""
+        provider = FakeSliceProvider({(ACCEL, TOPOLOGY): 1})
+        (s,) = provider.allocate("g1", ACCEL, TOPOLOGY, 1)
+        provider.inject_preemption(s.id)
+        provider.release("g1")  # shrink path releasing the departed gang
+        provider.repair(s.id)
+        assert s.state == SliceState.FREE and s.holder is None
+        # opposite order: repair lands before the release
+        (s2,) = provider.allocate("g2", ACCEL, TOPOLOGY, 1)
+        provider.inject_preemption(s2.id)
+        provider.repair(s2.id)
+        provider.release("g2")
+        assert s2.state == SliceState.FREE and s2.holder is None
+
+    def test_repair_of_held_slice_does_not_double_grow(self):
+        """A duplicate repair notice for a slice the running elastic gang
+        holds must not bump the resize generation."""
+        cluster, controller, provider, _ = make_stack(4)
+        job = elastic_job("ela-dup", virtual=4, lo=2, hi=4)
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+        held = job_pods(cluster, "ela-dup")[0].metadata.annotations[
+            constants.ANNOTATION_SLICE_ID
+        ]
+        provider.repair(held)  # stale notice: slice was never preempted
+        controller.sync_job(job.key())
+        now = stored(cluster, "ela-dup")
+        assert now.status.elastic["generation"] == 0
+        assert len(job_pods(cluster, "ela-dup")) == 4
+
+
+class TestSpecSurface:
+    def test_validation_bounds(self):
+        from tf_operator_tpu.api.validation import ValidationError, validate
+
+        def mk(lo, hi, virtual=4):
+            job = new_tpujob(worker=virtual, name="ela-val", defaulted=False)
+            job.spec.replica_specs[ReplicaType.WORKER].elastic = ElasticPolicy(
+                min_replicas=lo, max_replicas=hi
+            )
+            return job
+
+        validate(mk(1, 4))
+        validate(mk(2, 2))
+        with pytest.raises(ValidationError):
+            validate(mk(0, 4))  # floor below 1
+        with pytest.raises(ValidationError):
+            validate(mk(1, 5))  # physical can never outnumber virtual
+        with pytest.raises(ValidationError):
+            validate(mk(3, 2))  # min > max
+
+    def test_serialization_roundtrip(self):
+        job = elastic_job("ela-ser", virtual=4, lo=2, hi=4)
+        job.status.elastic = elastic_status_doc(job)
+        data = job_to_dict(job)
+        rspec = data["spec"]["replicaSpecs"]["Worker"]
+        assert rspec["elastic"] == {"minReplicas": 2, "maxReplicas": 4}
+        back = job_from_dict(data)
+        pol = back.spec.replica_specs[ReplicaType.WORKER].elastic
+        assert (pol.min_replicas, pol.max_replicas) == (2, 4)
+        assert back.status.elastic == job.status.elastic
+
+    def test_defaults_fill_bounds(self):
+        job = new_tpujob(worker=4, name="ela-def", defaulted=False)
+        job.spec.replica_specs[ReplicaType.WORKER].elastic = ElasticPolicy()
+        set_defaults(job)
+        pol = job.spec.replica_specs[ReplicaType.WORKER].elastic
+        assert pol.min_replicas == 1
+        assert pol.max_replicas == 4

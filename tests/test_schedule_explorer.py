@@ -636,6 +636,192 @@ class ShardLeaseScenario(explore.Scenario):
 
 
 # ---------------------------------------------------------------------------
+# 6. elastic resize invariants (runtime/reconciler.py _reconcile_elastic)
+
+
+class ElasticResizeScenario(explore.Scenario):
+    """The virtual-replica mapping invariant under a spec resize racing a
+    slice preemption (docs/elasticity.md failure matrix, bottom-right
+    cell): one real elastic TPUJob (V=4, bounds [2,4]) on the full
+    in-memory stack — InMemoryCluster + TPUJobController + GangScheduler +
+    FakeSliceProvider — while three adversaries interleave: the sync loop,
+    a whole-slice preemption (+ later repair), and a spec resize that
+    shrinks maxReplicas to 3 then restores 4.
+
+    After EVERY sync: the stamped assignment hosts each virtual replica j
+    exactly once at physical j % P with lo <= P <= hi, live pods carry
+    unique replica indices below P, and the job has never transitioned
+    Failed.  After the schedule: the gang is back at full width with the
+    identity mapping.  Each step runs under an outer model lock (the
+    ShardLeaseScenario pattern) so the explorer permutes step ORDER —
+    the preemption and the spec write land between any two sync passes
+    the schedule chooses, which is reconcile granularity: the controller
+    only ever observes cluster state between its own passes."""
+
+    name = "elastic-resize-vs-preemption"
+    # Each schedule replays ~10 model-locked steps, and every sync pass
+    # walks the full reconcile path (pods, services, gang, status); a
+    # smaller tier-1 budget keeps the pin sub-10s while the
+    # ANALYSIS_EXPLORE_BUDGET sweep covers the long tail.
+    fast_schedules = 40
+    NAME = "ela-race"
+    ACCEL = "v5e-4"
+    TOPOLOGY = "2x2"  # 4 chips = 1 host: one slice per physical replica
+    VIRTUAL, LO, HI = 4, 2, 4
+
+    def build(self):
+        from tf_operator_tpu.api.defaults import set_defaults
+        from tf_operator_tpu.api.types import (
+            ElasticPolicy,
+            ReplicaType,
+            RestartPolicy,
+            TPUTopology,
+        )
+        from tf_operator_tpu.controller.controller import TPUJobController
+        from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+        from tf_operator_tpu.runtime.scheduler import GangScheduler
+        from tf_operator_tpu.runtime.slices import FakeSliceProvider
+
+        from testutil import new_tpujob
+
+        cluster = InMemoryCluster()
+        controller = TPUJobController(
+            cluster, config=ReconcilerConfig(enable_gang_scheduling=True))
+        provider = FakeSliceProvider(
+            {(self.ACCEL, self.TOPOLOGY): self.VIRTUAL})
+        scheduler = GangScheduler(cluster, slice_provider=provider)
+        controller.gang_scheduler = scheduler
+
+        job = new_tpujob(worker=self.VIRTUAL, name=self.NAME,
+                         restart_policy=RestartPolicy.EXIT_CODE)
+        rspec = job.spec.replica_specs[ReplicaType.WORKER]
+        rspec.tpu = TPUTopology(accelerator=self.ACCEL,
+                                topology=self.TOPOLOGY)
+        rspec.elastic = ElasticPolicy(min_replicas=self.LO,
+                                      max_replicas=self.HI)
+        set_defaults(job)
+        cluster.create_job(job)
+        state = {"cluster": cluster, "controller": controller,
+                 "provider": provider, "key": job.key(),
+                 "model": locks.new_lock("model")}
+        # Deterministic prologue: the gang admits and runs at full width
+        # before the adversaries start.
+        self._sync(state)
+        self._sync(state)
+        return state
+
+    @classmethod
+    def _pods(cls, state):
+        return state["cluster"].list_pods(selector={"job-name": cls.NAME})
+
+    @classmethod
+    def _sync(cls, state) -> None:
+        """One controller pass + kubelet stand-in (PENDING pods start
+        RUNNING), then the mapping invariant.  requires: model lock held
+        (or the single-threaded build/check phases)."""
+        from tf_operator_tpu.api.core import PodPhase
+
+        state["controller"].sync_job(state["key"])
+        for pod in cls._pods(state):
+            if pod.status.phase == PodPhase.PENDING:
+                state["cluster"].set_pod_phase(
+                    "default", pod.metadata.name, PodPhase.RUNNING)
+        cls._check_mapping(state)
+
+    @classmethod
+    def _check_mapping(cls, state) -> None:
+        from tf_operator_tpu.api import constants
+        from tf_operator_tpu.api.types import JobConditionType
+
+        job = state["cluster"].get_job("default", cls.NAME)
+        doc = job.status.elastic
+        assert doc is not None, "elastic job lost its mapping doc"
+        group = doc["groups"]["Worker"]
+        physical = group["physical"]
+        assert group["min"] <= physical <= group["max"], group
+        assert group["virtual"] == cls.VIRTUAL, group
+        # Every virtual replica hosted exactly once, at j % P — none
+        # lost, none double-run.
+        expect = {str(j): j % physical for j in range(cls.VIRTUAL)}
+        assert group["assignment"] == expect, (
+            f"assignment {group['assignment']} != {expect} at P={physical}")
+        indices = [int(p.metadata.labels[constants.LABEL_REPLICA_INDEX])
+                   for p in cls._pods(state)]
+        assert len(indices) == len(set(indices)), (
+            f"duplicate replica index: {sorted(indices)}")
+        assert all(0 <= i < physical for i in indices), (
+            f"pod index outside physical width {physical}: {sorted(indices)}")
+        assert JobConditionType.FAILED not in {
+            c.type for c in job.status.conditions
+        }, "elastic job transitioned Failed during resize/preemption race"
+
+    def threads(self, state):
+        model, provider, cluster = (
+            state["model"], state["provider"], state["cluster"])
+
+        def sync_loop():
+            for _ in range(5):
+                with model:
+                    self._sync(state)
+                explore.yield_point()
+
+        def fabric():
+            # The fabric reclaims one slice out from under the gang, then
+            # repairs it a step later.
+            with model:
+                held = [s for s in provider.list_slices()
+                        if s.holder == state["key"]]
+                target = held[-1].id if held else None
+                state["preempted"] = target
+                if target is not None:
+                    provider.inject_preemption(target)
+            explore.yield_point()
+            with model:
+                if state.get("preempted") is not None:
+                    provider.repair(state["preempted"])
+            explore.yield_point()
+
+        def resizer():
+            from tf_operator_tpu.api.types import ReplicaType
+
+            for width in (3, self.HI):
+                with model:
+                    job = cluster.get_job("default", self.NAME)
+                    elastic = job.spec.replica_specs[
+                        ReplicaType.WORKER].elastic
+                    elastic.max_replicas = width
+                    cluster.update_job(job)
+                explore.yield_point()
+
+        return [
+            ("sync", sync_loop),
+            ("fabric", fabric),
+            ("resize", resizer),
+        ]
+
+    def check(self, state):
+        from tf_operator_tpu.runtime.slices import SliceState
+
+        # Deterministic settle: repair anything still preempted, then let
+        # the controller converge.  Two passes re-grow (repair capacity is
+        # visible to the grow check) and re-run the fresh gang; a third
+        # retracts Resizing once the full-width gang reports Running.
+        for s in state["provider"].list_slices():
+            if s.state == SliceState.PREEMPTED:
+                state["provider"].repair(s.id)
+        for _ in range(3):
+            self._sync(state)
+        job = state["cluster"].get_job("default", self.NAME)
+        group = job.status.elastic["groups"]["Worker"]
+        assert group["physical"] == self.VIRTUAL, (
+            f"failed to re-grow after repair: {group}")
+        assert len(self._pods(state)) == self.VIRTUAL
+        # Width changes (if the schedule exercised any) are journaled.
+        for entry in job.status.elastic["history"]:
+            assert entry["from"] != entry["to"], entry
+
+
+# ---------------------------------------------------------------------------
 # drivers
 
 REAL_CODE_SCENARIOS = [
@@ -644,6 +830,7 @@ REAL_CODE_SCENARIOS = [
     QueueScenario,
     QuarantineScenario,
     ShardLeaseScenario,
+    ElasticResizeScenario,
 ]
 
 

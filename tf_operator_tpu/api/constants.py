@@ -75,6 +75,13 @@ ENV_SLICE_TOPOLOGY = "TPUJOB_SLICE_TOPOLOGY"  # e.g. "2x4" chips
 ENV_ACCELERATOR = "TPUJOB_ACCELERATOR"  # e.g. "v5litepod-8"
 ENV_REPLICA_TYPE = "TPUJOB_REPLICA_TYPE"
 ENV_REPLICA_INDEX = "TPUJOB_REPLICA_INDEX"
+# Elastic virtual-replica mapping (docs/elasticity.md): V virtual replicas
+# (the fixed spec width) multiplexed onto P physical replicas; each physical
+# worker derives its virtual set as {j : j % P == replica_index}.  The
+# generation ties a running gang to the resize-doc revision that laid it out.
+ENV_VIRTUAL_REPLICAS = "TPUJOB_VIRTUAL_REPLICAS"
+ENV_PHYSICAL_REPLICAS = "TPUJOB_PHYSICAL_REPLICAS"
+ENV_ELASTIC_GENERATION = "TPUJOB_ELASTIC_GENERATION"
 # Multi-slice (DCN) coordination env, emitted when one replica group spans
 # more than one slice — the names JAX/libtpu multislice reads.
 ENV_MEGASCALE_COORDINATOR = "MEGASCALE_COORDINATOR_ADDRESS"

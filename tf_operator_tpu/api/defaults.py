@@ -45,6 +45,13 @@ def set_defaults_replica(spec: ReplicaSpec) -> None:
         spec.replicas = 1
     if spec.restart_policy is None:
         spec.restart_policy = DEFAULT_RESTART_POLICY
+    if spec.elastic is not None:
+        # replicas is the virtual width V; physical bounds default to the
+        # widest safe band: [1, V].
+        if spec.elastic.min_replicas is None:
+            spec.elastic.min_replicas = 1
+        if spec.elastic.max_replicas is None:
+            spec.elastic.max_replicas = int(spec.replicas)
     _set_default_port(spec)
     _set_default_tpu_resources(spec)
 

@@ -23,6 +23,7 @@ from .core import (
 from .defaults import normalize_replica_type
 from .types import (
     CleanPodPolicy,
+    ElasticPolicy,
     JobCondition,
     JobConditionType,
     JobStatus,
@@ -78,6 +79,11 @@ def _replica_to_dict(rs: ReplicaSpec) -> Dict[str, Any]:
             "topology": rs.tpu.topology,
             "mesh": dict(rs.tpu.mesh),
             "zeroShardWeightUpdate": rs.tpu.zero_shard_weight_update,
+        }
+    if rs.elastic is not None:
+        out["elastic"] = {
+            "minReplicas": rs.elastic.min_replicas,
+            "maxReplicas": rs.elastic.max_replicas,
         }
     return out
 
@@ -144,6 +150,7 @@ def status_to_dict(status: JobStatus) -> Dict[str, Any]:
         "startTime": status.start_time,
         "completionTime": status.completion_time,
         "zeroShardingPlan": status.zero_sharding_plan,
+        "elastic": status.elastic,
     }
 
 
@@ -204,11 +211,19 @@ def _replica_from_dict(data: Dict[str, Any]) -> ReplicaSpec:
                 tpu_raw.get("zeroShardWeightUpdate", False)
             ),
         )
+    elastic_raw = data.get("elastic")
+    elastic = None
+    if elastic_raw:
+        elastic = ElasticPolicy(
+            min_replicas=elastic_raw.get("minReplicas"),
+            max_replicas=elastic_raw.get("maxReplicas"),
+        )
     return ReplicaSpec(
         replicas=data.get("replicas"),
         restart_policy=RestartPolicy(restart) if restart else None,
         template=template,
         tpu=tpu,
+        elastic=elastic,
     )
 
 
@@ -306,6 +321,7 @@ def status_from_dict(data: Dict[str, Any]) -> JobStatus:
         start_time=data.get("startTime"),
         completion_time=data.get("completionTime"),
         zero_sharding_plan=data.get("zeroShardingPlan"),
+        elastic=data.get("elastic"),
     )
 
 

@@ -121,6 +121,12 @@ class JobConditionType(str, Enum):
     # quarantines a job after repeated consecutive sync failures, flipped
     # False on the first successful sync (docs/self-healing.md).
     STUCK = "Stuck"
+    # No reference analogue: an elastic job whose virtual→physical mapping
+    # is changing (preemption shrink, repair grow, or spec resize).  The
+    # gang is drained and re-emitted at the new physical width; flipped
+    # False (RunningResized) once the resized gang is running
+    # (docs/elasticity.md).
+    RESIZING = "Resizing"
 
 
 @dataclass
@@ -158,6 +164,11 @@ class JobStatus:
     # is on — the searchable layout record the AMP planner (ROADMAP item 3)
     # reads back.  None when the knob is off.
     zero_sharding_plan: Optional[Dict[str, object]] = None
+    # Elastic virtual→physical mapping document (see elastic_status_doc),
+    # stamped by the reconciler for jobs with an elastic policy: current
+    # resize generation, per-group virtual/physical widths, and the bounded
+    # resize history.  None for non-elastic jobs.
+    elastic: Optional[Dict[str, object]] = None
 
 
 @dataclass
@@ -209,6 +220,24 @@ class TPUTopology:
 
 
 @dataclass
+class ElasticPolicy:
+    """Elastic virtual-replica policy (VirtualFlow, arXiv:2009.09523).
+
+    `replicas` on the owning ReplicaSpec becomes the *virtual* replica
+    count V — the fixed logical width the workload is written against.
+    The controller maps those V virtual replicas onto P physical replicas
+    (pods / slice hosts), P ∈ [min_replicas, max_replicas], shrinking on
+    slice preemption and re-growing on repair instead of failing the job.
+    Virtual replica j runs on physical replica j % P; gradient
+    accumulation keeps the global batch semantics identical across P
+    (docs/elasticity.md).
+    """
+
+    min_replicas: Optional[int] = None  # floor; below it the gang waits
+    max_replicas: Optional[int] = None  # ceiling; defaults to V
+
+
+@dataclass
 class ReplicaSpec:
     """(ref: vendor/.../apis/common/v1/types.go:79-92)"""
 
@@ -216,6 +245,9 @@ class ReplicaSpec:
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
     restart_policy: Optional[RestartPolicy] = None
     tpu: Optional[TPUTopology] = None
+    # When set the group is elastic: `replicas` counts virtual replicas,
+    # the physical pod count floats within the policy's bounds.
+    elastic: Optional[ElasticPolicy] = None
 
 
 @dataclass
@@ -264,6 +296,88 @@ def is_evaluator(rtype: ReplicaType) -> bool:
 def contains_chief_or_master(job: TPUJob) -> bool:
     """(ref: pkg/controller.v1/tensorflow/util.go:45-52)"""
     return any(is_chief_or_master(rt) for rt in job.spec.replica_specs)
+
+
+def is_elastic(job: TPUJob) -> bool:
+    """True when any replica group carries an elastic policy."""
+    return any(rs.elastic is not None for rs in job.spec.replica_specs.values())
+
+
+def elastic_bounds(rspec: ReplicaSpec) -> tuple:
+    """(min, max, virtual) physical-width bounds for an elastic group.
+
+    Virtual width V is rspec.replicas; min defaults to 1, max to V.  Only
+    meaningful when rspec.elastic is set (callers gate on that).
+    """
+    virtual = int(rspec.replicas or 1)
+    pol = rspec.elastic
+    lo = int(pol.min_replicas) if pol and pol.min_replicas is not None else 1
+    hi = int(pol.max_replicas) if pol and pol.max_replicas is not None else virtual
+    return lo, hi, virtual
+
+
+def effective_replicas(job: TPUJob, rtype: ReplicaType) -> int:
+    """Physical replica count the controller should run for `rtype` right
+    now: the resize-doc width for elastic groups (status.elastic, stamped
+    by the reconciler), else the spec width.  Non-elastic groups always use
+    the spec width — the doc never overrides them."""
+    rspec = job.spec.replica_specs.get(rtype)
+    if rspec is None:
+        return 0
+    spec_width = int(rspec.replicas or 1)
+    if rspec.elastic is None:
+        return spec_width
+    lo, hi, _ = elastic_bounds(rspec)
+    doc = job.status.elastic or {}
+    group = (doc.get("groups") or {}).get(rtype.value) or {}
+    physical = group.get("physical")
+    if physical is None:
+        return min(spec_width, hi)
+    # Clamp against the *current* spec bounds so a spec resize immediately
+    # narrows a stale doc width.
+    return max(lo, min(int(physical), hi))
+
+
+def effective_total_replicas(job: TPUJob) -> int:
+    """Physical pod count across all groups (the elastic-aware analogue of
+    defaults.total_replicas, which counts spec/virtual widths)."""
+    return sum(effective_replicas(job, rt) for rt in job.spec.replica_specs)
+
+
+def elastic_status_doc(job: TPUJob) -> Optional[Dict[str, object]]:
+    """The virtual→physical mapping document stamped into status.elastic
+    for elastic jobs, or None when no group is elastic.
+
+    Carries the current resize generation, per-group widths, and the
+    virtual→physical assignment (virtual j → physical j % P) so operators
+    and the resume path can read the live mapping without re-deriving it.
+    The resize `history` list is appended by the reconciler on each
+    transition and preserved here.
+    """
+    if not is_elastic(job):
+        return None
+    prior = job.status.elastic or {}
+    groups: Dict[str, object] = {}
+    for rtype in REPLICA_TYPE_ORDER:
+        rspec = job.spec.replica_specs.get(rtype)
+        if rspec is None or rspec.elastic is None:
+            continue
+        lo, hi, virtual = elastic_bounds(rspec)
+        physical = effective_replicas(job, rtype)
+        groups[rtype.value] = {
+            "virtual": virtual,
+            "physical": physical,
+            "min": lo,
+            "max": hi,
+            "assignment": {
+                str(j): j % physical for j in range(virtual)
+            } if physical > 0 else {},
+        }
+    return {
+        "generation": int(prior.get("generation") or 0),
+        "groups": groups,
+        "history": list(prior.get("history") or []),
+    }
 
 
 def zero_sharding_plan_doc(spec: TPUJobSpec) -> Optional[Dict[str, object]]:

@@ -131,6 +131,32 @@ def _validate_replica(rtype: ReplicaType, rspec) -> None:
             f"TPUJobSpec is not valid: more than one operator container in {rtype.value} template"
         )
 
+    if rspec.elastic is not None:
+        virtual = int(rspec.replicas or 1)
+        lo = rspec.elastic.min_replicas
+        hi = rspec.elastic.max_replicas
+        if lo is not None and lo < 1:
+            raise ValidationError(
+                f"TPUJobSpec is not valid: elastic.minReplicas for {rtype.value} "
+                f"must be >= 1, got {lo}"
+            )
+        if hi is not None and hi > virtual:
+            raise ValidationError(
+                f"TPUJobSpec is not valid: elastic.maxReplicas for {rtype.value} "
+                f"({hi}) exceeds the virtual replica count ({virtual}) — physical "
+                "replicas can never outnumber the virtual replicas they host"
+            )
+        if lo is not None and lo > virtual:
+            raise ValidationError(
+                f"TPUJobSpec is not valid: elastic.minReplicas for {rtype.value} "
+                f"({lo}) exceeds the virtual replica count ({virtual})"
+            )
+        if lo is not None and hi is not None and lo > hi:
+            raise ValidationError(
+                f"TPUJobSpec is not valid: elastic.minReplicas ({lo}) > "
+                f"elastic.maxReplicas ({hi}) for {rtype.value}"
+            )
+
     if rspec.tpu is not None and rspec.tpu.topology:
         try:
             chips = rspec.tpu.num_chips()

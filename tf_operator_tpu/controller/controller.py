@@ -182,6 +182,9 @@ class TPUJobController(JobPlugin):
         self.resync_period_current = (
             self.reconciler.config.reconciler_sync_loop_period
         )
+        # gang scheduler is attached post-construction (server.py wiring);
+        # the property setter hooks its slice provider's repair events
+        self._gang_scheduler = None
 
         cluster.watch_jobs(self._on_job_event)
         cluster.watch_pods(self._on_pod_event)
@@ -994,3 +997,63 @@ class TPUJobController(JobPlugin):
 
     def on_pod_created(self, job: TPUJob, rtype: ReplicaType) -> None:
         pass
+
+    @property
+    def gang_scheduler(self):
+        return self._gang_scheduler
+
+    @gang_scheduler.setter
+    def gang_scheduler(self, scheduler) -> None:
+        """Attaching the gang scheduler also subscribes to its slice
+        provider's fabric events: a REPAIR is new capacity, and re-growing
+        an elastic job is a job-sync decision (_reconcile_elastic), so the
+        affected jobs must be requeued — without this the grow waits for
+        the periodic resync backstop (minutes on a quiet cluster).  The
+        scheduler's own watcher handles the preemption side by failing the
+        slice's pods, which requeues via the pod watch."""
+        self._gang_scheduler = scheduler
+        provider = getattr(scheduler, "slice_provider", None)
+        if provider is not None:
+            provider.watch(self._on_slice_repaired)
+
+    def _on_slice_repaired(self, slc, event: str) -> None:
+        if event != "repaired":
+            return
+        from ..api.types import is_elastic
+
+        try:
+            jobs = self.cluster.list_jobs()
+        except Exception:  # noqa: BLE001 — a fabric event must never die here
+            log.warning("slice %s repaired: listing jobs for elastic "
+                        "requeue failed", slc.id)
+            return
+        for job in jobs:
+            if is_elastic(job) and not conditions.is_finished(job.status):
+                self._mark_active(job.key())
+                self._enqueue(job.key())
+
+    def usable_slice_hosts(self, job: TPUJob, accelerator: str,
+                           topology: str):
+        """Host capacity an elastic group of this slice shape could run on:
+        hosts of FREE slices plus hosts of slices this job's gang already
+        holds (the gang key is namespace/name, the slice holder string the
+        scheduler allocates under).  None when no slice provider is wired —
+        the elastic engine then never grows."""
+        provider = getattr(
+            getattr(self, "gang_scheduler", None), "slice_provider", None
+        )
+        if provider is None:
+            return None
+        from ..runtime.slices import SliceState, normalize_topology
+
+        shape_topology = normalize_topology(topology)
+        key = job.key()
+        hosts = 0
+        for s in provider.list_slices():
+            if s.accelerator != accelerator or s.topology != shape_topology:
+                continue
+            if s.state == SliceState.FREE or (
+                s.state == SliceState.ALLOCATED and s.holder == key
+            ):
+                hosts += s.hosts
+        return hosts

@@ -27,6 +27,7 @@ from ..api.types import (
     SuccessPolicy,
     TPUJob,
     contains_chief_or_master,
+    effective_replicas,
     is_chief_or_master,
 )
 from ..runtime import conditions
@@ -98,7 +99,12 @@ def update_job_status(
         if rspec is None:
             continue
         rs = status.replica_statuses.get(rtype.value, ReplicaStatus())
-        expected = int(rspec.replicas or 0) - rs.succeeded
+        # An elastic group runs (and therefore completes) at its PHYSICAL
+        # width, not the virtual spec width (docs/elasticity.md).
+        if rspec.elastic is not None:
+            expected = effective_replicas(job, rtype) - rs.succeeded
+        else:
+            expected = int(rspec.replicas or 0) - rs.succeeded
         running = rs.active
         failed = rs.failed
 

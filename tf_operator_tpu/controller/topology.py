@@ -28,12 +28,25 @@ from ..api.types import (
     REPLICA_TYPE_ORDER,
     ReplicaType,
     TPUJob,
+    effective_replicas,
+    elastic_bounds,
     is_chief_or_master,
 )
 from ..runtime.reconciler import gen_general_name, get_port_from_job
 
 # resolver(job, rtype, index, port) -> "host:port"
 AddressResolver = Callable[[TPUJob, ReplicaType, int, int], str]
+
+
+def _group_width(job: TPUJob, rtype: ReplicaType, rspec) -> int:
+    """Pods the group actually runs: the mapped PHYSICAL width for elastic
+    groups (resize doc, docs/elasticity.md), else the spec width.  Every
+    topology document below is addressed to real pods, so it must follow
+    the physical width — the virtual width only appears in the elastic env
+    vars that tell the workload how to multiplex."""
+    if rspec is not None and rspec.elastic is not None:
+        return effective_replicas(job, rtype)
+    return int(rspec.replicas or 0) if rspec is not None else 0
 
 
 def dns_resolver(job: TPUJob, rtype: ReplicaType, index: int, port: int) -> str:
@@ -55,7 +68,8 @@ def gen_cluster_spec(
     for rtype, rspec in job.spec.replica_specs.items():
         port = get_port_from_job(job.spec, rtype)
         cluster[rtype.value.lower()] = [
-            resolver(job, rtype, i, port) for i in range(int(rspec.replicas or 0))
+            resolver(job, rtype, i, port)
+            for i in range(_group_width(job, rtype, rspec))
         ]
     return cluster
 
@@ -99,7 +113,11 @@ def is_distributed(job: TPUJob) -> bool:
     count = 0
     for rtype in REPLICA_TYPE_ORDER:
         rspec = job.spec.replica_specs.get(rtype)
-        if rspec is not None:
+        if rspec is None:
+            continue
+        if rspec.elastic is not None:
+            count += effective_replicas(job, rtype)
+        else:
             count += int(rspec.replicas) if rspec.replicas is not None else 1
     return count != 1
 
@@ -121,7 +139,7 @@ def jax_process_layout(job: TPUJob) -> List[tuple]:
     for rtype in (ReplicaType.CHIEF, ReplicaType.MASTER, ReplicaType.WORKER):
         rspec = job.spec.replica_specs.get(rtype)
         if rspec is not None:
-            for i in range(int(rspec.replicas or 0)):
+            for i in range(_group_width(job, rtype, rspec)):
                 layout.append((rtype, i))
     return layout
 
@@ -151,6 +169,17 @@ def gen_tpu_env(
                 pass
 
     rspec = job.spec.replica_specs.get(rtype)
+    if rspec is not None and rspec.elastic is not None:
+        # Elastic mapping document (docs/elasticity.md): the workload
+        # derives its virtual-replica set as {j : j % P == index} and tags
+        # checkpoints with the generation the layout came from.
+        lo, hi, virtual = elastic_bounds(rspec)
+        env[constants.ENV_VIRTUAL_REPLICAS] = str(virtual)
+        env[constants.ENV_PHYSICAL_REPLICAS] = str(
+            effective_replicas(job, rtype)
+        )
+        generation = (job.status.elastic or {}).get("generation") or 0
+        env[constants.ENV_ELASTIC_GENERATION] = str(int(generation))
     if rspec is not None and rspec.tpu is not None:
         if rspec.tpu.accelerator:
             env[constants.ENV_ACCELERATOR] = rspec.tpu.accelerator
@@ -208,7 +237,7 @@ def _add_multislice_env(
         hosts = topology_hosts(rspec.tpu.topology)
     except ValueError:
         return
-    replicas = int(rspec.replicas or 0)
+    replicas = _group_width(job, rtype, rspec)
     num_slices = max(1, math.ceil(replicas / hosts))
     if num_slices < 2:
         return

@@ -87,6 +87,13 @@ def update_job_conditions(
         _remove_condition(status.conditions, JobConditionType.RESTARTING)
     elif ctype == JobConditionType.RESTARTING:
         _remove_condition(status.conditions, JobConditionType.RUNNING)
+    elif ctype == JobConditionType.RESIZING:
+        # A resizing gang is down (drained for the new topology document),
+        # so Running comes off like it does for Restarting.  The flip back
+        # is NOT removal: the reconciler retracts Resizing to status False
+        # (reason RunningResized) via clear_condition once the resized gang
+        # runs, keeping the transition in the condition list as history.
+        _remove_condition(status.conditions, JobConditionType.RUNNING)
 
     _set_condition(status.conditions, cond)
 

@@ -16,6 +16,14 @@ classifier is what turns that into a JobRestarting cycle instead of JobFailed.
 RETRYABLE_EXIT_CODES = frozenset({130, 137, 143, 138})
 PERMANENT_EXIT_CODES = frozenset({1, 2, 126, 127, 128, 139})
 
+# The infrastructure-kill subset of the retryable codes: exactly what a
+# preempted TPU-VM produces (SIGINT/SIGKILL/SIGTERM).  Restarts caused by
+# these are the fabric's fault, not the workload's, so backoff accounting
+# exempts them — a crash-looping job and a job riding out preemptions must
+# not share a budget.  138 (SIGUSR1, user-signalled retry) stays counted:
+# the workload asked for that restart itself.
+PREEMPTION_EXIT_CODES = frozenset({130, 137, 143})
+
 # Sentinel used when a failed pod carries no terminated container state
 # (ref: pkg/controller.v1/tensorflow/pod.go:124 — 0xbeef default).
 UNKNOWN_EXIT_CODE = 0xBEEF
@@ -27,3 +35,7 @@ def is_retryable_exit_code(exit_code: int) -> bool:
 
 def is_permanent_exit_code(exit_code: int) -> bool:
     return not is_retryable_exit_code(exit_code)
+
+
+def is_preemption_exit_code(exit_code: int) -> bool:
+    return exit_code in PREEMPTION_EXIT_CODES

@@ -20,7 +20,7 @@ of the 15-method ControllerInterface (vendor/.../apis/common/v1/interface.go:10-
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ..api import constants
 from ..api.core import (
@@ -41,9 +41,14 @@ from ..api.types import (
     RestartPolicy,
     TPUJob,
     TPUJobSpec,
+    effective_replicas,
+    effective_total_replicas,
+    elastic_bounds,
+    elastic_status_doc,
+    is_elastic,
     zero_sharding_plan_doc,
 )
-from ..utils import clock
+from ..utils import clock, locks
 from ..utils import logging as tpulog
 from ..utils import metrics
 from . import conditions
@@ -94,6 +99,16 @@ class JobPlugin:
 
         return is_retryable_exit_code(exit_code)
 
+    def usable_slice_hosts(
+        self, job: TPUJob, accelerator: str, topology: str
+    ) -> Optional[int]:
+        """Host capacity an elastic group of this slice shape could run on
+        right now: hosts of FREE slices plus hosts of slices this job
+        already holds.  None means unknown (no slice provider wired into
+        this deployment) — the engine then never grows, only spec resizes
+        and preemption shrinks apply."""
+        return None
+
 
 @dataclass
 class ReconcilerConfig:
@@ -124,6 +139,44 @@ class ReconcileResult:
     # tracker uses this: a pass that wrote nothing AND left expectations
     # satisfied is an idle job the event-driven resync backstop may skip.
     wrote_status: bool = False
+    # did this pass stamp a new elastic generation and drain a gang for it?
+    resized: bool = False
+
+
+# Pod failure reason the gang scheduler stamps on whole-slice preemption
+# victims (runtime/scheduler.py _on_slice_event); the elastic engine and the
+# backoff exemption key off it.
+SLICE_PREEMPTED_REASON = "SlicePreempted"
+
+# Resize-history entries kept in status.elastic (newest last): enough to
+# audit a burst of preempt/repair cycles without growing status unboundedly.
+ELASTIC_HISTORY_LIMIT = 20
+
+# Fleet-wide {job key: (mapped, resizing)} virtual-replica counts behind the
+# tpujob_virtual_replicas gauge.  Gauges carry absolute values, so each pass
+# republishes the sums instead of inc/dec deltas (idempotent under the
+# event-driven resync's repeated passes).
+_virtual_replica_lock = locks.new_lock("virtual-replica-gauge")
+_virtual_replica_states: Dict[str, Tuple[int, int]] = {}  # guarded-by: _virtual_replica_lock
+
+
+def _publish_virtual_replicas(
+    job_key: str, mapped: Optional[int], resizing: int
+) -> None:
+    """Record one job's virtual-replica split and republish the fleet sums.
+    mapped=None drops the job (terminal/deleted)."""
+    with _virtual_replica_lock:
+        if mapped is None:
+            _virtual_replica_states.pop(job_key, None)
+        else:
+            _virtual_replica_states[job_key] = (mapped, resizing)
+        snapshot = list(_virtual_replica_states.values())
+    metrics.virtual_replicas.labels("mapped").set(
+        sum(m for m, _ in snapshot)
+    )
+    metrics.virtual_replicas.labels("resizing").set(
+        sum(r for _, r in snapshot)
+    )
 
 
 def gen_labels(job_name: str) -> Dict[str, str]:
@@ -321,6 +374,8 @@ class JobReconciler:
                 for rs in job.status.replica_statuses.values():
                     rs.succeeded += rs.active
                     rs.active = 0
+            if is_elastic(job):
+                _publish_virtual_replicas(job.key(), None, 0)
             result.terminal = True
             result.wrote_status = self._write_status_if_changed(job, old_status)
             return result
@@ -355,10 +410,24 @@ class JobReconciler:
             if job.status.completion_time is None:
                 job.status.completion_time = clock.now()
             metrics.jobs_failed.labels().inc()
+            if is_elastic(job):
+                _publish_virtual_replicas(job.key(), None, 0)
             result.terminal = True
             result.failed_reason = failure_reason
             result.wrote_status = self._write_status_if_changed(job, old_status)
             return result
+
+        # Elastic resize arc (docs/elasticity.md): detect a mapped-width
+        # change — preemption shrink, repair/grow, spec resize — stamp the
+        # new virtual→physical mapping doc and drain the old gang.  Runs
+        # BEFORE sync_gang so the PodGroup min_member refresh in this same
+        # pass gates admission at the new width, and the drained pods are
+        # dropped from this pass's view so every index is recreated at the
+        # new width below (not double-deleted next pass).
+        resizing_this_pass, drained = self._reconcile_elastic(job, pods)
+        result.resized = resizing_this_pass
+        if drained:
+            pods = [p for p in pods if p.metadata.name not in drained]
 
         # Gang scheduling: ensure the PodGroup exists before any pod
         # (ref: job.go:217-223; all-or-nothing slice allocation).
@@ -384,9 +453,45 @@ class JobReconciler:
                 restarting_this_pass = True
             self.reconcile_services(job, services, rtype, rspec)
 
+        # A resizing pass looks like a restart to the status engine: the
+        # drained gang must not read as a failure while the resized one
+        # comes up.
         self.plugin.update_job_status(
-            job, replicas, job.status, pods, restarting_this_pass
+            job, replicas, job.status, pods,
+            restarting_this_pass or resizing_this_pass,
         )
+        # The resized gang runs again: retract Resizing to False in place
+        # (condition history keeps the arc visible), mirroring how terminal
+        # conditions flip Running rather than removing it.
+        if (
+            not resizing_this_pass
+            and conditions.is_running(job.status)
+            and conditions.has_condition(
+                job.status, conditions.JobConditionType.RESIZING
+            )
+        ):
+            generation = int((job.status.elastic or {}).get("generation") or 0)
+            conditions.clear_condition(
+                job.status,
+                conditions.JobConditionType.RESIZING,
+                "RunningResized",
+                f"TPUJob {job.metadata.name} is running at resize "
+                f"generation {generation}",
+            )
+        if is_elastic(job):
+            total_virtual = sum(
+                elastic_bounds(rs)[2]
+                for rs in job.spec.replica_specs.values()
+                if rs.elastic is not None
+            )
+            mid_resize = conditions.has_condition(
+                job.status, conditions.JobConditionType.RESIZING
+            )
+            _publish_virtual_replicas(
+                job.key(),
+                0 if mid_resize else total_virtual,
+                total_virtual if mid_resize else 0,
+            )
         result.wrote_status = self._write_status_if_changed(job, old_status)
         # ActiveDeadlineSeconds enforcement: re-arm the wakeup on EVERY
         # pass, not only when start_time is first set (the plugin hook,
@@ -406,6 +511,144 @@ class JobReconciler:
         return result
 
     # ------------------------------------------------------------------
+    # elastic virtual replicas (no reference analogue — VirtualFlow-style
+    # virtual-device indirection, docs/elasticity.md)
+
+    def _reconcile_elastic(
+        self, job: TPUJob, pods: List[Pod]
+    ) -> Tuple[bool, Set[str]]:
+        """Decide the physical width P of every elastic replica group.
+
+        spec.replicas stays the FIXED virtual width V of the group; P
+        floats in [minReplicas, maxReplicas] and virtual replica j runs on
+        physical replica j % P.  Transitions, in priority order per group:
+
+          SpecResized     — the current spec bounds no longer admit the
+                            stored width (user edited replicas/min/max):
+                            adopt the clamped width.
+          SlicePreempted  — whole-slice preemption failed `lost` physical
+                            replicas and P-lost >= min: shrink to P-lost
+                            instead of dying.  Below the floor the group
+                            HOLDS its width and waits for repair (the
+                            ordinary retryable-restart path recreates the
+                            pods, which pend until capacity returns).
+          SliceRepaired   — capacity reappeared and P < max: grow to
+                            min(max, usable hosts).
+
+        Any transition bumps the resize generation, appends history,
+        raises the Resizing condition, and drains EVERY pod of the resized
+        groups — the TF_CONFIG/topology world changes for all members, so
+        a partial drain would leave survivors addressing dead peers.
+        Returns (resized, names of drained pods).
+        """
+        if not is_elastic(job):
+            return False, set()
+        log = tpulog.logger_for_job(job)
+        doc = elastic_status_doc(job)
+        prior = job.status.elastic if isinstance(job.status.elastic, dict) else {}
+        prior_groups = prior.get("groups") or {}
+        transitions = []  # (rtype, from_width, to_width, reason)
+
+        for rtype, rspec in job.spec.replica_specs.items():
+            if rspec.elastic is None:
+                continue
+            lo, hi, virtual = elastic_bounds(rspec)
+            group = doc["groups"][rtype.value]
+            current = int(group["physical"])
+            prior_width = (prior_groups.get(rtype.value) or {}).get("physical")
+            if prior_width is None:
+                continue  # first pass: initial stamp only, no transition
+            if int(prior_width) != current:
+                # elastic_status_doc clamps the stored width to the live
+                # spec bounds, so a difference here IS a spec resize.
+                transitions.append((rtype, int(prior_width), current, "SpecResized"))
+                continue
+
+            lost = {
+                pod.metadata.labels.get(constants.LABEL_REPLICA_INDEX)
+                for pod in filter_for_replica_type(pods, rtype)
+                if pod.status.phase == PodPhase.FAILED
+                and pod.status.reason == SLICE_PREEMPTED_REASON
+            }
+            lost.discard(None)
+            if lost:
+                target = current - len(lost)
+                if target >= lo:
+                    group["physical"] = target
+                    group["assignment"] = {
+                        str(j): j % target for j in range(virtual)
+                    }
+                    transitions.append(
+                        (rtype, current, target, SLICE_PREEMPTED_REASON)
+                    )
+                else:
+                    log.info(
+                        "elastic %s: %d replicas preempted but width %d is "
+                        "below floor %d; holding and waiting for repair",
+                        rtype.value, len(lost), target, lo,
+                    )
+                continue
+
+            if current < hi and rspec.tpu is not None and rspec.tpu.topology:
+                capacity = self.plugin.usable_slice_hosts(
+                    job, rspec.tpu.accelerator, rspec.tpu.topology
+                )
+                if capacity is not None:
+                    target = min(hi, int(capacity))
+                    if target > current:
+                        group["physical"] = target
+                        group["assignment"] = {
+                            str(j): j % target for j in range(virtual)
+                        }
+                        transitions.append(
+                            (rtype, current, target, "SliceRepaired")
+                        )
+
+        drained: Set[str] = set()
+        if transitions:
+            doc["generation"] = int(doc.get("generation") or 0) + 1
+            history = doc.setdefault("history", [])
+            for rtype, frm, to, reason in transitions:
+                history.append({
+                    "generation": doc["generation"],
+                    "group": rtype.value,
+                    "from": frm,
+                    "to": to,
+                    "reason": reason,
+                    "time": clock.now(),
+                })
+                metrics.resizes.labels(reason).inc()
+                log.info(
+                    "elastic %s: resizing %d -> %d (%s), generation %d",
+                    rtype.value, frm, to, reason, doc["generation"],
+                )
+            del history[:-ELASTIC_HISTORY_LIMIT]
+            for rtype, _, _, _ in transitions:
+                for pod in filter_for_replica_type(pods, rtype):
+                    self._delete_pod(job, rtype, pod)
+                    drained.add(pod.metadata.name)
+            summary = "; ".join(
+                f"{rtype.value} {frm}->{to} ({reason})"
+                for rtype, frm, to, reason in transitions
+            )
+            conditions.update_job_conditions(
+                job.status,
+                conditions.JobConditionType.RESIZING,
+                "JobResizing",
+                f"TPUJob {job.metadata.name} is resizing: {summary}",
+            )
+            self.cluster.record_event(Event(
+                object_kind=job.kind,
+                object_name=job.metadata.name,
+                namespace=job.metadata.namespace,
+                event_type="Normal",
+                reason="JobResizing",
+                message=f"Resizing to generation {doc['generation']}: {summary}",
+            ))
+        job.status.elastic = doc
+        return bool(transitions), drained
+
+    # ------------------------------------------------------------------
     # pods (ref: TF override ReconcilePods, pkg/.../pod.go:64-160, atop
     # common/pod.go slice machinery)
 
@@ -420,7 +663,12 @@ class JobReconciler:
         """Returns True if a retryable-failure restart happened this pass."""
         log = tpulog.logger_for_replica(job, rtype)
         pods = filter_for_replica_type(all_pods, rtype)
-        num_replicas = int(rspec.replicas or 0)
+        # Elastic groups run at the mapped PHYSICAL width from the resize
+        # doc, not the virtual spec width; non-elastic groups are untouched.
+        if rspec.elastic is not None:
+            num_replicas = effective_replicas(job, rtype)
+        else:
+            num_replicas = int(rspec.replicas or 0)
         slices = get_pod_slices(pods, num_replicas)
         gang_restart = False
         restarted = False
@@ -625,7 +873,10 @@ class JobReconciler:
         self, job: TPUJob, all_services: List[Service], rtype: ReplicaType, rspec: ReplicaSpec
     ) -> None:
         services = filter_for_replica_type(all_services, rtype)
-        num_replicas = int(rspec.replicas or 0)
+        if rspec.elastic is not None:
+            num_replicas = effective_replicas(job, rtype)
+        else:
+            num_replicas = int(rspec.replicas or 0)
         slices = get_service_slices(services, num_replicas)
 
         for index, svc_slice in enumerate(slices):
@@ -746,10 +997,24 @@ class JobReconciler:
         min_member = (
             sp.min_available
             if sp is not None and sp.min_available is not None
-            else total_replicas(job)
+            else (
+                effective_total_replicas(job)
+                if is_elastic(job)
+                else total_replicas(job)
+            )
         )
         try:
-            return self.cluster.get_podgroup(job.metadata.namespace, job.metadata.name)
+            pg = self.cluster.get_podgroup(job.metadata.namespace, job.metadata.name)
+            if pg.min_member != min_member:
+                # Elastic resize changed the gang size this pass: the
+                # admission gate must see the new width before the
+                # recreated pods' ADDED events reach the scheduler, or
+                # admission waits a full retry sweep.
+                pg.min_member = min_member
+                update = getattr(self.cluster, "update_podgroup", None)
+                if update is not None:
+                    pg = update(pg)
+            return pg
         except NotFound:
             pg = PodGroup(
                 metadata=ObjectMeta(
@@ -782,7 +1047,11 @@ class JobReconciler:
         min_available = (
             sp.min_available
             if sp is not None and sp.min_available is not None
-            else total_replicas(job)
+            else (
+                effective_total_replicas(job)
+                if is_elastic(job)
+                else total_replicas(job)
+            )
         )
         try:
             pdb = self.cluster.get_pdb(job.metadata.namespace, job.metadata.name)
@@ -841,7 +1110,14 @@ class JobReconciler:
     def past_backoff_limit(self, job: TPUJob, pods: List[Pod]) -> bool:
         """Sum container restart counts of Running pods over restartable
         replicas; limit 0 means any restart fails the job
-        (ref: PastBackoffLimit, common/job.go:268-305)."""
+        (ref: PastBackoffLimit, common/job.go:268-305).
+
+        Preemption exemption (ISSUE: elastic jobs): restarts the fabric
+        caused — a pod the gang scheduler failed as SlicePreempted, or a
+        container whose last exit code is in PREEMPTION_EXIT_CODES — do not
+        count toward the limit."""
+        from .exit_codes import is_preemption_exit_code
+
         limit = job.spec.run_policy.backoff_limit
         if limit is None:
             return False
@@ -854,7 +1130,21 @@ class JobReconciler:
             for pod in filter_for_replica_type(pods, rtype):
                 if pod.status.phase != PodPhase.RUNNING:
                     continue  # (ref: job.go:287-289)
+                if pod.status.reason == SLICE_PREEMPTED_REASON:
+                    # Preemption is the fabric's fault, not the workload's:
+                    # a job riding out preemptions must not share a backoff
+                    # budget with a crash-looping one.
+                    continue
                 for cs in pod.status.container_statuses:
+                    if cs.exit_code is not None and is_preemption_exit_code(
+                        cs.exit_code
+                    ):
+                        # Approximation: PodStatus keeps only the LAST
+                        # terminated code, so a preemption code exempts the
+                        # whole counter for this container — per-restart
+                        # attribution would need history the substrate
+                        # doesn't retain.
+                        continue
                     restarts += cs.restart_count
         if limit == 0:
             return restarts > 0

@@ -168,24 +168,46 @@ class FakeSliceProvider(SliceProvider):
 
     # -- fault injection (test-server analogue for the fabric) --
 
-    def inject_preemption(self, slice_id: str) -> Slice:
+    def inject_preemption(self, slice_id: str) -> Optional[Slice]:
         """The fabric takes the slice back (maintenance/defrag/preemptible
         reclaim) — the TPU-VM event the reference maps to exit codes
-        130/137/143 (SURVEY §5 failure detection)."""
+        130/137/143 (SURVEY §5 failure detection).  Unknown ids are logged
+        and ignored (same at-least-once tolerance as repair); preempting an
+        already-PREEMPTED slice re-fires no event."""
         with self._lock:
-            s = self._find(slice_id)
+            try:
+                s = self._find(slice_id)
+            except KeyError:
+                log.info("ignoring preemption for unknown slice %s", slice_id)
+                return None
+            if s.state == SliceState.PREEMPTED:
+                return s
             s.state = SliceState.PREEMPTED
         for handler in list(self._watchers):
             handler(s, "preempted")
         return s
 
-    def repair(self, slice_id: str) -> Slice:
+    def repair(self, slice_id: str) -> Optional[Slice]:
         """The fabric re-provisions a preempted slice; it returns to the
-        free pool.  A repair for a slice that is not preempted is a stale or
-        duplicate notice and is ignored — freeing a live ALLOCATED slice
-        would double-book it under a running gang."""
+        free pool.  Idempotent no-op everywhere else, because repair notices
+        are delivered at-least-once and race releases/shrinks:
+          - a never-preempted (FREE/ALLOCATED) slice is a stale or duplicate
+            notice — freeing a live ALLOCATED slice would double-book it
+            under a running gang, and re-announcing a FREE one would fire a
+            second "repaired" event and double-grow an elastic job;
+          - a second repair of the same slice sees FREE and is absorbed the
+            same way (exactly one "repaired" event per preemption);
+          - an unknown slice id (inventory shrank) is logged and ignored.
+        The holder is cleared under the lock before the event fires, so a
+        racing shrink's release() never resurrects a stale claim: by the
+        time any watcher observes "repaired" the slice is FREE with no
+        holder, whichever of repair/release ran first."""
         with self._lock:
-            s = self._find(slice_id)
+            try:
+                s = self._find(slice_id)
+            except KeyError:
+                log.info("ignoring repair for unknown slice %s", slice_id)
+                return None
             if s.state != SliceState.PREEMPTED:
                 log.info("ignoring repair for %s in state %s", s.id, s.state)
                 return s

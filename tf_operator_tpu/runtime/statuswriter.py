@@ -57,6 +57,10 @@ def snapshot_status(status: JobStatus) -> Tuple:
         # stamping/clearing the plan must count as a status change.
         json.dumps(status.zero_sharding_plan, sort_keys=True)
         if status.zero_sharding_plan is not None else None,
+        # Same treatment for the elastic mapping doc: a resize (generation
+        # bump, width change, history append) is exactly one transition.
+        json.dumps(status.elastic, sort_keys=True)
+        if status.elastic is not None else None,
     )
 
 

@@ -275,6 +275,24 @@ status_writes_coalesced = REGISTRY.counter(
     "Status transitions absorbed without a wire write (stale-read echoes "
     "suppressed + extra transitions merged into one PUT)",
 )
+# Elastic virtual-replica jobs (docs/elasticity.md): resize transitions by
+# reason (SlicePreempted shrink, SliceRepaired grow, SpecResized), and the
+# fleet-wide virtual-replica population by state — "mapped" counts virtual
+# replicas hosted on a steady physical gang, "resizing" counts those whose
+# group is mid-drain/re-admit.  A preemption shows as a resizes_total bump
+# and a transient mapped→resizing dip, NOT as a jobs_failed increment.
+resizes = REGISTRY.counter(
+    "tpujob_resizes_total",
+    "Elastic resize transitions (gang drained and re-emitted at a new "
+    "physical width), by trigger reason",
+    ("reason",),
+)
+virtual_replicas = REGISTRY.gauge(
+    "tpujob_virtual_replicas",
+    "Virtual replicas of elastic jobs by state (mapped = hosted on a "
+    "steady gang, resizing = group mid-resize)",
+    ("state",),
+)
 # Shard-lease federation (runtime/shardlease.py, docs/federation.md): how
 # many shard leases each replica currently holds, and the handoff churn.
 # A healthy fleet shows leases_held summing to the shard count with

@@ -111,6 +111,14 @@ def main(argv=None) -> int:
     ctx = WorkloadContext.from_env()
     print(f"lm workload: role={ctx.replica_type} index={ctx.replica_index} "
           f"mesh={ctx.mesh_shape}", flush=True)
+    if ctx.is_elastic:
+        # The elastic mapping line is the log artifact the resize e2e and
+        # operators correlate with status.elastic: which virtual replicas
+        # this process hosts, under which resize generation.
+        print(f"elastic mapping: virtual={ctx.virtual_replicas} "
+              f"physical={ctx.physical_replicas} "
+              f"generation={ctx.elastic_generation} "
+              f"hosted={ctx.virtual_assignment()}", flush=True)
     ctx.initialize_distributed()
 
     import jax

@@ -108,10 +108,39 @@ class WorkloadContext:
     # spec tpu.zeroShardWeightUpdate → TPUJOB_ZERO_SHARD_WEIGHT_UPDATE → here;
     # workloads treat it as the default for --zero-shard-weight-update.
     zero_shard_weight_update: bool = False
+    # Elastic virtual-replica mapping (docs/elasticity.md): V fixed virtual
+    # replicas multiplexed onto the current physical width.  0/0 means the
+    # group is not elastic.
+    virtual_replicas: int = 0
+    physical_replicas: int = 0
+    elastic_generation: int = 0
 
     @property
     def is_coordinator(self) -> bool:
         return (self.process_id or 0) == 0
+
+    @property
+    def is_elastic(self) -> bool:
+        return self.virtual_replicas > 0 and self.physical_replicas > 0
+
+    def virtual_assignment(self) -> list:
+        """The virtual replica ids THIS physical replica hosts:
+        {j : j % P == replica_index}.  Empty for non-elastic contexts."""
+        if not self.is_elastic:
+            return []
+        return [
+            j for j in range(self.virtual_replicas)
+            if j % self.physical_replicas == self.replica_index
+        ]
+
+    def accumulation_steps(self) -> int:
+        """Gradient-accumulation factor that keeps the GLOBAL batch fixed
+        across resizes: each physical replica sequentially runs one
+        microbatch per hosted virtual replica, so V virtual contributions
+        reach every update regardless of the physical width."""
+        if not self.is_elastic:
+            return 1
+        return len(self.virtual_assignment())
 
     @classmethod
     def from_env(cls, env: Optional[Dict[str, str]] = None) -> "WorkloadContext":
@@ -135,6 +164,15 @@ class WorkloadContext:
             zero_shard_weight_update=env.get(
                 constants.ENV_ZERO_SHARD_WEIGHT_UPDATE, ""
             ).lower() in ("1", "true"),
+            virtual_replicas=int(
+                env.get(constants.ENV_VIRTUAL_REPLICAS, "0") or 0
+            ),
+            physical_replicas=int(
+                env.get(constants.ENV_PHYSICAL_REPLICAS, "0") or 0
+            ),
+            elastic_generation=int(
+                env.get(constants.ENV_ELASTIC_GENERATION, "0") or 0
+            ),
         )
         # TF_CONFIG task block wins when present (parity with the reference's
         # contract: the task identity is authoritative there).
