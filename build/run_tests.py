@@ -83,7 +83,15 @@ def run_lint_tier(junit_dir: str, paths: list[str]) -> int:
     interprocedural included) plus the tests tree (test-hygiene rules only:
     sleep-poll, with the known-bad lint fixtures excluded).  Each pass also
     writes its machine-readable findings (`--json`) next to
-    lint-summary.json so CI uploads them as one artifact set."""
+    lint-summary.json so CI uploads them as one artifact set.
+
+    The default (no-paths) run additionally sweeps every in-package
+    explorer scenario through the race-checked explorer (`--race all`,
+    docs/static-analysis.md#the-race-detector) under a bounded schedule
+    budget — ANALYSIS_EXPLORE_BUDGET if set, else 150 — writing
+    `race-findings.json` next to `lint-findings.json`.  Race findings are
+    deterministic (seeded schedules), so like static findings they get no
+    retries."""
     if paths:
         targets = [(p if os.path.isabs(p) else os.path.join(ROOT, p), [])
                    for p in paths]
@@ -111,10 +119,21 @@ def run_lint_tier(junit_dir: str, paths: list[str]) -> int:
                "--json", json_path, *extra]
         print("+", " ".join(cmd), flush=True)
         rc |= subprocess.call(cmd, cwd=ROOT, env=env)
+    race_schedules = None
+    if not paths:
+        race_schedules = int(os.environ.get("ANALYSIS_EXPLORE_BUDGET", "150"))
+        race_json = os.path.join(junit_dir, "race-findings.json")
+        findings_json.append(race_json)
+        cmd = [sys.executable, "-m", "tf_operator_tpu.analysis",
+               "--race", "all", "--schedules", str(race_schedules),
+               "--json", race_json]
+        print("+", " ".join(cmd), flush=True)
+        rc |= subprocess.call(cmd, cwd=ROOT, env=env)
     status = "pass" if rc == 0 else "fail"
     with open(os.path.join(junit_dir, "lint-summary.json"), "w") as f:
         json.dump({"tier": "lint", "attempts": 1, "status": status,
                    "targets": [t for t, _extra in targets],
+                   "race_schedules": race_schedules,
                    "findings_json": findings_json}, f, indent=2)
     print(f"RESULT tier=lint attempts=1 status={status}", flush=True)
     return 0 if rc == 0 else 1

@@ -1,0 +1,25 @@
+"""Known-bad fixture: rule `ownership-fence` must fire exactly once
+(line 13): an enqueue in a federated module (it references the shard
+manager) with no owns()/owns_key() check in the enclosing function.  The
+fenced twin and the fenced worker pop are clean."""
+
+
+class FederatedController:
+    def __init__(self, work_queue, shard_manager):
+        self.work_queue = work_queue
+        self.shard_manager = shard_manager
+
+    def enqueue_unfenced(self, key):
+        self.work_queue.add(key)
+
+    def enqueue_fenced(self, key):
+        if self.shard_manager.owns(self.work_queue.shard_index(key)):
+            self.work_queue.add(key)
+
+    def pop_fenced(self, shard):
+        shard_queue = self.work_queue.shard(shard)
+        key = shard_queue.get(timeout=0.5)
+        if not self.shard_manager.owns(shard):
+            shard_queue.done(key)
+            return None
+        return key

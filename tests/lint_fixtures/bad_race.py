@@ -1,0 +1,44 @@
+"""Known-bad fixture for the DYNAMIC race detector — and the static
+rules' documented blind spot: every static rule passes on this file (no
+bare lock, no guarded-by annotation to violate), yet `unlocked_bump`
+mutates shared state with no lock and the race-checked explorer
+(analysis/explore.py + analysis/racedetect.py) reports it.
+
+Pinned in tests/test_schedule_explorer.py: the race is found at schedule
+#0 from seed 0 (it exists in EVERY interleaving — no lock edge ever
+orders the two threads), exactly one report survives (FastTrack's
+first-race-per-variable retirement), and replay() of the recorded
+decision trace reproduces it."""
+from tf_operator_tpu.analysis import explore
+from tf_operator_tpu.utils import locks
+
+
+@locks.shared_state
+class Gauge:
+    def __init__(self):
+        self.lock = locks.new_lock("bad-race-gauge")
+        self.value = 0
+
+
+class BadRaceScenario(explore.Scenario):
+    name = "bad-race"
+
+    def build(self):
+        return Gauge()
+
+    def threads(self, state):
+        def locked_bump():
+            with state.lock:
+                value = state.value
+                explore.yield_point()
+                state.value = value + 1
+
+        def unlocked_bump():
+            value = state.value
+            explore.yield_point()
+            state.value = value + 1
+
+        return [("locked", locked_bump), ("unlocked", unlocked_bump)]
+
+    def check(self, state):
+        pass  # the race IS the failure; the final value is immaterial

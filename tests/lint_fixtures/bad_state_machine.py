@@ -1,0 +1,22 @@
+"""Known-bad fixture: rule `state-machine` must fire exactly once
+(line 9): a RESIZING set transition with a reason outside the declared
+edge set (CONDITION_STATE_MACHINES: set via JobResizing, clear via
+RunningResized).  The declared transitions below are clean, and other
+condition types stay unconstrained."""
+
+
+def shrink(status, conditions, JobConditionType):
+    conditions.update_job_conditions(
+        status, JobConditionType.RESIZING, "SliceShrunk", "undeclared edge")
+
+
+def resize_declared(status, conditions, JobConditionType):
+    conditions.update_job_conditions(
+        status, JobConditionType.RESIZING, "JobResizing", "declared edge")
+    conditions.clear_condition(
+        status, JobConditionType.RESIZING, "RunningResized", "declared edge")
+
+
+def unconstrained(status, conditions, JobConditionType):
+    conditions.update_job_conditions(
+        status, JobConditionType.RUNNING, "AnyReasonAtAll", "no machine")

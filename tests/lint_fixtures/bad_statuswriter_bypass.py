@@ -1,0 +1,16 @@
+"""Known-bad fixture: rule `statuswriter-bypass` must fire exactly once
+(line 8): a direct status PUT around the coalescing writer.  The same
+call inside a class named CoalescingStatusWriter (the sanctioned path's
+own body) is exempt."""
+
+
+def mark_failed(cluster, namespace, name, status):
+    cluster.update_job_status(namespace, name, status)
+
+
+class CoalescingStatusWriter:
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def write(self, namespace, name, status):
+        self.cluster.update_job_status(namespace, name, status)

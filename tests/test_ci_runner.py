@@ -67,30 +67,43 @@ def test_hard_failure_exhausts_retries(tmp_path):
 
 def test_lint_tier_passes_on_clean_repo_package(tmp_path):
     """`--tier lint` with no paths: the package (all rules) AND the tests
-    tree (sleep-poll, fixtures excluded) — zero findings, pass line,
-    summary JSON, machine-readable findings uploaded next to it, and no
-    pytest/junit machinery involved."""
+    tree (sleep-poll, fixtures excluded) AND the race-checked explorer
+    sweep (bounded by ANALYSIS_EXPLORE_BUDGET) — zero findings, pass
+    line, summary JSON, machine-readable findings uploaded next to it,
+    and no pytest/junit machinery involved."""
+    env = dict(os.environ)
+    env["ANALYSIS_EXPLORE_BUDGET"] = "20"  # keep the sweep test-sized
     proc = subprocess.run(
         [sys.executable, str(RUNNER), "--tier", "lint",
          "--root", str(tmp_path), "--junit-dir", "junit"],
-        capture_output=True, text=True,
+        capture_output=True, text=True, env=env,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "RESULT tier=lint attempts=1 status=pass" in proc.stdout
     assert "0 finding(s)" in proc.stdout
+    assert "0 race finding(s)" in proc.stdout
     summary = json.loads(
         (tmp_path / "junit" / "lint-summary.json").read_text())
     assert summary["status"] == "pass"
     assert summary["targets"] == [str(REPO / "tf_operator_tpu"),
                                   str(REPO / "tests")]
+    assert summary["race_schedules"] == 20
     assert summary["findings_json"] == [
         str(tmp_path / "junit" / "lint-findings.json"),
         str(tmp_path / "junit" / "lint-findings-tests.json"),
+        str(tmp_path / "junit" / "race-findings.json"),
     ]
     for path in summary["findings_json"]:
         doc = json.loads(Path(path).read_text())
         assert doc["count"] == 0 and doc["findings"] == []
-        assert doc["version"] == 1
+        # schema v2 is strictly additive: a v1 reader checking only
+        # version/count/findings (as above) keeps working; v2 readers can
+        # key on the schema identifier
+        assert doc["version"] == 2
+        assert doc["schema"] == "tf-operator-tpu/lint-findings"
+    race_doc = json.loads(
+        (tmp_path / "junit" / "race-findings.json").read_text())
+    assert race_doc["target"] == "race:all"
     assert not (tmp_path / "junit" / "lint.xml").exists()
 
 

@@ -1,0 +1,309 @@
+"""tf_operator_tpu.analysis.racedetect + the utils.locks seams it rides.
+
+Three layers:
+  1. the lock event chain — on every InstrumentedLock acquire/release the
+     registry AND every registered LockWatcher fire, in a deterministic
+     order (registry first, then watchers in registration order), with
+     the release event delivered while the lock is still held;
+  2. the access seam — set_access_tracker/track_access and the
+     `@shared_state` decorator that feeds it;
+  3. the detector's happens-before core — lock release→acquire edges,
+     fork/join barrier edges, and FastTrack's first-race-per-variable
+     retirement, driven directly with real threads (run strictly
+     back-to-back, so the ONLY ordering the detector can see is the one
+     under test).
+"""
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from tf_operator_tpu.analysis import racedetect
+from tf_operator_tpu.utils import locks
+
+
+class _RecordingWatcher(locks.LockWatcher):
+    def __init__(self, name, log):
+        self.name = name
+        self.log = log
+
+    def on_acquired(self, lock):
+        self.log.append((self.name, "acquired", lock.name))
+
+    def on_released(self, lock):
+        # The contract racedetect builds on: the release event arrives
+        # BEFORE the underlying lock is released, so a successor's
+        # acquire can never observe the lock free before the watcher saw
+        # the release.
+        self.log.append((self.name, "released", lock.name, lock.locked()))
+
+
+# ---------------------------------------------------------------------------
+# 1. the lock event chain
+
+
+def test_registry_and_watchers_both_fire_in_order():
+    """The explicit hook chain: registry bookkeeping first, then every
+    watcher in registration order, on both acquire and release."""
+    log = []
+
+    class _RegistryProbe(locks.LockWatcher):
+        """Fires inside the watcher chain; by then the registry must
+        already have recorded the acquisition — registry-first order."""
+
+        def __init__(self, registry):
+            self.registry = registry
+
+        def on_acquired(self, lock):
+            names = [n for (_, _, n) in self.registry.acquisitions]
+            log.append(("probe", "registry-saw", lock.name in names))
+
+        def on_released(self, lock):
+            pass
+
+    first = _RecordingWatcher("first", log)
+    second = _RecordingWatcher("second", log)
+    with locks.instrumented() as registry:
+        probe = _RegistryProbe(registry)
+        locks.add_lock_watcher(probe)
+        locks.add_lock_watcher(first)
+        locks.add_lock_watcher(second)
+        try:
+            lock = locks.new_lock("chain-test")
+            with lock:
+                pass
+        finally:
+            locks.remove_lock_watcher(first)
+            locks.remove_lock_watcher(second)
+            locks.remove_lock_watcher(probe)
+    assert log == [
+        ("probe", "registry-saw", True),
+        ("first", "acquired", "chain-test"),
+        ("second", "acquired", "chain-test"),
+        ("first", "released", "chain-test", True),
+        ("second", "released", "chain-test", True),
+    ]
+    # and the registry recorded the same event the watchers did
+    assert [n for (_, _, n) in registry.acquisitions] == ["chain-test"]
+
+
+def test_removed_watcher_stops_firing_and_others_survive():
+    log = []
+    first = _RecordingWatcher("first", log)
+    second = _RecordingWatcher("second", log)
+    locks.add_lock_watcher(first)
+    locks.add_lock_watcher(second)
+    try:
+        locks.remove_lock_watcher(first)
+        with locks.instrumented():
+            with locks.new_lock("after-removal"):
+                pass
+    finally:
+        locks.remove_lock_watcher(second)
+    assert [entry[0] for entry in log] == ["second", "second"]
+
+
+# ---------------------------------------------------------------------------
+# 2. the access seam
+
+
+def test_track_access_is_a_noop_without_a_tracker():
+    locks.track_access(object(), "field", True)  # must not raise
+
+
+def test_set_access_tracker_returns_previous_and_restores():
+    events = []
+    prev = locks.set_access_tracker(
+        lambda obj, f, w: events.append((f, w)))
+    try:
+        sentinel = object()
+        locks.track_access(sentinel, "x", True)
+        locks.track_access(sentinel, "x", False)
+    finally:
+        restored = locks.set_access_tracker(prev)
+    assert events == [("x", True), ("x", False)]
+    assert restored is not None  # the lambda came back out
+    locks.track_access(object(), "x", True)  # tracker gone again: no-op
+
+
+def test_shared_state_reports_instance_fields_only():
+    """Writes via __setattr__, reads only of instance-__dict__ fields;
+    dunders and class-level lookups (methods) stay silent."""
+    events = []
+
+    @locks.shared_state
+    class Gauge:
+        def __init__(self):
+            self.value = 0
+
+        def bump(self):
+            self.value += 1
+
+    prev = locks.set_access_tracker(
+        lambda obj, f, w: events.append((type(obj).__name__, f, w)))
+    try:
+        g = Gauge()
+        g.bump()
+        _ = g.value
+        g.bump  # method lookup: class attribute, not shared state
+    finally:
+        locks.set_access_tracker(prev)
+    assert ("Gauge", "value", True) in events
+    assert ("Gauge", "value", False) in events
+    assert not any(f.startswith("__") for (_, f, _) in events)
+    assert not any(f == "bump" for (_, f, _) in events)
+
+
+# ---------------------------------------------------------------------------
+# 3. the detector's happens-before core
+
+
+def _run_threads_sequentially(detector, *bodies):
+    """Run each body in its own real thread, strictly one after another.
+    Plain sequencing gives the INTERPRETER an ordering but gives the
+    DETECTOR none — only the lock / fork / join edges under test order
+    the accesses it sees.  All threads are kept alive until every body
+    has run: a joined thread's ident can be REUSED by the next Thread,
+    which would fold two logical threads into one vector-clock entry."""
+    detector.fork_barrier()
+    gates = [threading.Event() for _ in bodies]
+    done = [threading.Event() for _ in bodies]
+
+    def wrap(i, body):
+        def run():
+            gates[i].wait()
+            body()
+            done[i].set()
+            done[-1].wait()  # stay alive: idents must remain unique
+        return run
+
+    threads = [threading.Thread(target=wrap(i, body), name=f"det-unit-{i}",
+                                daemon=True)
+               for i, body in enumerate(bodies)]
+    for t in threads:
+        t.start()
+    for i in range(len(bodies)):
+        gates[i].set()
+        done[i].wait()
+    for t in threads:
+        t.join()
+
+
+def _install(detector):
+    locks.add_lock_watcher(detector)
+    prev = locks.set_access_tracker(detector.on_access)
+
+    def uninstall():
+        locks.set_access_tracker(prev)
+        locks.remove_lock_watcher(detector)
+
+    return uninstall
+
+
+def test_unordered_writes_race_and_lock_edge_orders_them():
+    obj = object()
+    with locks.instrumented():
+        lock = locks.new_lock("hb-edge")
+
+        # unlocked: two threads, no common lock -> write-write race
+        det = racedetect.RaceDetector()
+        uninstall = _install(det)
+        try:
+            _run_threads_sequentially(
+                det,
+                lambda: det.on_access(obj, "f", True),
+                lambda: det.on_access(obj, "f", True),
+            )
+        finally:
+            uninstall()
+        assert [r.kind for r in det.races] == ["write-write"]
+        assert det.races[0].var == "object.f"
+
+        # locked: release->acquire edge orders the same two writes
+        det = racedetect.RaceDetector()
+        uninstall = _install(det)
+        try:
+            def locked_write():
+                with lock:
+                    det.on_access(obj, "f", True)
+
+            _run_threads_sequentially(det, locked_write, locked_write)
+        finally:
+            uninstall()
+        assert det.races == []
+
+
+def test_fork_and_join_barriers_order_setup_and_check():
+    """Build-phase writes happen-before thread reads (fork edge); thread
+    writes happen-before post-join reads (join edge)."""
+    obj = object()
+    det = racedetect.RaceDetector()
+    uninstall = _install(det)
+    try:
+        det.on_access(obj, "f", True)          # main-thread setup write
+        _run_threads_sequentially(
+            det,
+            lambda: det.on_access(obj, "f", False),  # ordered by fork
+            lambda: det.on_access(obj, "g", True),
+        )
+        det.join_barrier()
+        det.on_access(obj, "g", False)         # check-phase read, ordered
+    finally:
+        uninstall()
+    assert det.races == []
+
+
+def test_first_race_per_variable_retires_it():
+    """FastTrack policy: a variable reports one race, then goes silent —
+    but OTHER variables still report."""
+    obj = object()
+    det = racedetect.RaceDetector()
+    uninstall = _install(det)
+    try:
+        _run_threads_sequentially(
+            det,
+            lambda: (det.on_access(obj, "f", True),
+                     det.on_access(obj, "g", True)),
+            lambda: (det.on_access(obj, "f", True),   # race 1: f retires
+                     det.on_access(obj, "f", True),   # silent
+                     det.on_access(obj, "g", True)),  # race 2: g
+        )
+    finally:
+        uninstall()
+    assert sorted(r.var for r in det.races) == ["object.f", "object.g"]
+
+
+def test_read_read_is_not_a_race():
+    obj = object()
+    det = racedetect.RaceDetector()
+    uninstall = _install(det)
+    try:
+        _run_threads_sequentially(
+            det,
+            lambda: det.on_access(obj, "f", False),
+            lambda: det.on_access(obj, "f", False),
+        )
+    finally:
+        uninstall()
+    assert det.races == []
+
+
+def test_race_report_names_threads_and_sites():
+    obj = object()
+    det = racedetect.RaceDetector()
+    uninstall = _install(det)
+    try:
+        _run_threads_sequentially(
+            det,
+            lambda: det.on_access(obj, "f", True),
+            lambda: det.on_access(obj, "f", True),
+        )
+    finally:
+        uninstall()
+    (report,) = det.races
+    rendered = report.render()
+    assert "data race on object.f (write-write)" in rendered
+    assert "det-unit-0" in rendered and "det-unit-1" in rendered
+    assert "test_racedetect.py:" in rendered
+    assert "no lock or fork/join edge" in rendered

@@ -22,13 +22,16 @@ sweep is opt-in).
 """
 from __future__ import annotations
 
+import importlib.util
 import os
 import time
+from pathlib import Path
 from types import SimpleNamespace
 
 import pytest
 
 from tf_operator_tpu.analysis import explore
+from tf_operator_tpu.analysis.scenarios import ElasticResizeRaceScenario
 from tf_operator_tpu.controller.health import (
     ACTION_PARKED,
     ACTION_QUARANTINED,
@@ -190,6 +193,45 @@ def test_explorer_detects_deadlock_or_inversion():
 
 def test_yield_point_is_a_noop_outside_the_explorer():
     explore.yield_point()  # must not raise or block
+
+
+def _load_bad_race_fixture():
+    fixtures = Path(__file__).resolve().parent / "lint_fixtures"
+    spec = importlib.util.spec_from_file_location(
+        "bad_race_fixture", fixtures / "bad_race.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_race_detector_finds_bad_race_fixture_from_seed():
+    """The known-bad race fixture: statically CLEAN (the blind spot the
+    dynamic detector exists for), found at schedule #0 from the seed
+    because no interleaving orders the unlocked thread, reported exactly
+    once (first-race-per-variable), and replayable from the trace."""
+    from tf_operator_tpu import analysis
+
+    fixture = Path(__file__).resolve().parent / "lint_fixtures" / "bad_race.py"
+    assert analysis.check_file(str(fixture), rel_path="bad_race.py") == []
+
+    mod = _load_bad_race_fixture()
+    result = explore.explore(mod.BadRaceScenario(),
+                             schedules=FAST_SCHEDULES, seed=0)
+    failure = result.failure
+    assert failure is not None and failure.kind == explore.FAIL_RACE, result
+    assert failure.schedule_index == 0
+    assert failure.detail.count("data race") == 1
+    assert "Gauge.value" in failure.detail
+
+    # deterministic: same seed, same schedule, same trace
+    again = explore.explore(mod.BadRaceScenario(),
+                            schedules=FAST_SCHEDULES, seed=0)
+    assert again.failure.schedule_index == failure.schedule_index
+    assert again.failure.trace == failure.trace
+
+    replayed = explore.replay(mod.BadRaceScenario(), failure.trace)
+    assert replayed is not None and replayed.kind == explore.FAIL_RACE
+    assert "Gauge.value" in replayed.detail
 
 
 # ---------------------------------------------------------------------------
@@ -831,6 +873,9 @@ REAL_CODE_SCENARIOS = [
     QuarantineScenario,
     ShardLeaseScenario,
     ElasticResizeScenario,
+    # in-package (analysis/scenarios.py): the `--race` CLI's soak target,
+    # race-checked here at the full tier-1 budget like everything else
+    ElasticResizeRaceScenario,
 ]
 
 

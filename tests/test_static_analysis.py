@@ -49,6 +49,11 @@ FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
          "guarded-by-interproc", 17),
         ("bad_atomicity.py", "bad_atomicity.py", "atomicity", 19),
         ("bad_sleep_poll.py", "tests/bad_sleep_poll.py", "sleep-poll", 9),
+        ("bad_statuswriter_bypass.py", "bad_statuswriter_bypass.py",
+         "statuswriter-bypass", 8),
+        ("bad_ownership_fence.py", "bad_ownership_fence.py",
+         "ownership-fence", 13),
+        ("bad_state_machine.py", "bad_state_machine.py", "state-machine", 9),
     ],
 )
 def test_rule_fires_exactly_once(fixture, rel_path, rule, line):
@@ -688,6 +693,115 @@ def test_tests_tree_has_zero_sleep_poll_findings():
     assert findings == [], "\n".join(f.render("tests/") for f in findings)
 
 
+def test_statuswriter_bypass_exempts_writer_class_only():
+    """The rule keys on the RECEIVER shape (`cluster.` / `.cluster.`) and
+    exempts only code lexically inside a CoalescingStatusWriter class —
+    the sanctioned path's own flush."""
+    inside = (
+        "class CoalescingStatusWriter:\n"
+        "    def flush(self, ns, name, status):\n"
+        "        self.cluster.update_job_status(ns, name, status)\n"
+    )
+    outside = (
+        "def mark(cluster, ns, name, status):\n"
+        "    cluster.update_job_status(ns, name, status)\n"
+    )
+    other_receiver = (
+        "def mark(writer, job):\n"
+        "    writer.update_job_status(job)\n"
+    )
+    assert analysis.check_source(inside, "runtime/x.py") == []
+    assert [f.rule for f in analysis.check_source(outside, "runtime/x.py")] \
+        == ["statuswriter-bypass"]
+    # a non-cluster receiver is somebody else's method, not a wire PUT
+    assert analysis.check_source(other_receiver, "runtime/x.py") == []
+
+
+def test_ownership_fence_arms_only_in_federated_modules():
+    """A bare work_queue.add is fine in a module that never touches the
+    shard manager; the identical code fires once the module is federated,
+    and an owns()/owns_key() call anywhere in the function fences it."""
+    unfederated = (
+        "class C:\n"
+        "    def enqueue(self, key):\n"
+        "        self.work_queue.add(key)\n"
+    )
+    federated = "class C:\n    shard_manager = None\n" + (
+        "    def enqueue(self, key):\n"
+        "        self.work_queue.add(key)\n"
+    )
+    fenced = "class C:\n    shard_manager = None\n" + (
+        "    def enqueue(self, key):\n"
+        "        if self.owns_key(key):\n"
+        "            self.work_queue.add(key)\n"
+    )
+    assert analysis.check_source(unfederated, "controller/x.py") == []
+    assert [f.rule for f in analysis.check_source(federated, "controller/x.py")] \
+        == ["ownership-fence"]
+    assert analysis.check_source(fenced, "controller/x.py") == []
+
+
+def test_ownership_fence_tracks_queue_aliases():
+    """A pop through a variable assigned from a work_queue call is still
+    a worker pop and needs the fence."""
+    src = (
+        "class C:\n"
+        "    shard_manager = None\n"
+        "    def pop(self, shard):\n"
+        "        q = self.work_queue.shard(shard)\n"
+        "        return q.get(timeout=0.5)\n"
+    )
+    assert [f.rule for f in analysis.check_source(src, "controller/x.py")] \
+        == ["ownership-fence"]
+
+
+def test_state_machine_rejects_nonliteral_reasons():
+    """Literal reasons are checked against the declared edge set; a
+    non-literal reason makes the edge set uncheckable and is itself a
+    finding.  Condition types without a declared machine are unchecked."""
+    nonliteral = (
+        "def f(status, conditions, JobConditionType, why):\n"
+        "    conditions.update_job_conditions(\n"
+        "        status, JobConditionType.RESIZING, why, 'msg')\n"
+    )
+    declared_kwargs = (
+        "def f(status, conditions, JobConditionType):\n"
+        "    conditions.clear_condition(\n"
+        "        status, ctype=JobConditionType.RESIZING,\n"
+        "        reason='RunningResized', message='msg')\n"
+    )
+    wrong_verb = (
+        "def f(status, conditions, JobConditionType):\n"
+        "    conditions.clear_condition(\n"
+        "        status, JobConditionType.RESIZING, 'JobResizing', 'msg')\n"
+    )
+    assert [f.rule for f in analysis.check_source(nonliteral, "x.py")] \
+        == ["state-machine"]
+    assert analysis.check_source(declared_kwargs, "x.py") == []
+    # JobResizing is a SET-edge reason; using it on a clear is off-machine
+    assert [f.rule for f in analysis.check_source(wrong_verb, "x.py")] \
+        == ["state-machine"]
+
+
+def test_rule_doc_and_severity_metadata():
+    """Every rule id resolves to a docs anchor; dynamic (race/explore-*)
+    findings share the race-detector section.  Advisory rules are
+    warnings, everything else an error."""
+    assert len(analysis.ALL_RULES) == 13  # 12 rules + parse-error
+    for rule in (analysis.RULE_STATUSWRITER_BYPASS,
+                 analysis.RULE_OWNERSHIP_FENCE,
+                 analysis.RULE_STATE_MACHINE):
+        assert rule in analysis.ALL_RULES
+        assert analysis.rule_doc(rule) == f"docs/static-analysis.md#{rule}"
+        assert analysis.RULE_SEVERITY.get(rule, "error") == "error"
+    assert analysis.rule_doc(analysis.RULE_RACE) \
+        == "docs/static-analysis.md#the-race-detector"
+    assert analysis.rule_doc("explore-deadlock") \
+        == "docs/static-analysis.md#the-race-detector"
+    assert analysis.RULE_SEVERITY[analysis.RULE_SLEEP_POLL] == "warning"
+    assert analysis.RULE_RACE not in analysis.ALL_RULES  # dynamic-only
+
+
 # ---------------------------------------------------------------------------
 # 2. the package pin — the CI gate
 
@@ -729,7 +843,9 @@ def test_cli_exit_codes(tmp_path):
 
 def test_cli_json_output_schema(tmp_path):
     """--json writes the documented machine-readable findings document
-    (docs/static-analysis.md): version, target, count, findings[]."""
+    (docs/static-analysis.md): version 2 adds a `schema` identifier and
+    per-finding `severity` + `rule_doc` — strictly additive, so every v1
+    field is still present with its v1 meaning."""
     import json
 
     env = dict(os.environ)
@@ -746,13 +862,20 @@ def test_cli_json_output_schema(tmp_path):
     )
     assert proc.returncode == 1
     doc = json.loads(out.read_text())
-    assert doc["version"] == analysis.FINDINGS_JSON_VERSION
+    assert doc["version"] == analysis.FINDINGS_JSON_VERSION == 2
+    assert doc["schema"] == analysis.FINDINGS_JSON_SCHEMA
     assert doc["count"] == 1
     assert doc["findings"] == [{
         "rule": "bare-lock", "path": "__init__.py", "line": 2,
         "message": doc["findings"][0]["message"],
+        "severity": "error",
+        "rule_doc": "docs/static-analysis.md#bare-lock",
     }]
     assert "new_lock" in doc["findings"][0]["message"]
+    # a v1 reader — one that only touches the v1 fields — still works
+    v1_view = {k: doc["findings"][0][k]
+               for k in ("rule", "path", "line", "message")}
+    assert v1_view["rule"] == "bare-lock" and v1_view["line"] == 2
     # clean run still writes the document (count 0) — CI parses it blindly
     clean_out = tmp_path / "clean.json"
     proc = subprocess.run(

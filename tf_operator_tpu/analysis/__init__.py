@@ -33,6 +33,26 @@ machine-check the concurrency discipline the code relies on:
                   in the loop — the unbounded-poll flaky-test smell
                   `tests/testutil.py:sync_until` exists to prevent.
 
+Three architectural conformance rules check invariants of THIS control
+plane rather than generic concurrency hygiene:
+
+  statuswriter-bypass  every TPUJob status PUT must flow through
+                       `CoalescingStatusWriter` (runtime/statuswriter.py) —
+                       a direct `cluster.update_job_status(...)` anywhere
+                       else silently breaks the coalescer's last-written
+                       memory and the echo-suppression invariant.
+  ownership-fence      in federated modules (anything referencing the
+                       shard-lease manager), a work-queue enqueue or
+                       worker pop must sit in a function that checks
+                       `owns()` / `owns_key()` — an unfenced path processes
+                       keys another replica owns.
+  state-machine        condition transitions named in
+                       `CONDITION_STATE_MACHINES` (first machine: the
+                       elastic Resizing→RunningResized arc) must use a
+                       declared literal reason; an undeclared or
+                       non-literal reason is an edge the machine does not
+                       have.
+
 Three further rules are interprocedural and package-wide, built from a
 whole-program call graph + lock-acquisition graph (`analysis/lockgraph.py`):
 
@@ -78,9 +98,16 @@ RULE_SWALLOW = "swallow"
 RULE_THREAD_HYGIENE = "thread-hygiene"
 RULE_GUARDED_BY = "guarded-by"
 RULE_SLEEP_POLL = "sleep-poll"
+RULE_STATUSWRITER_BYPASS = "statuswriter-bypass"
+RULE_OWNERSHIP_FENCE = "ownership-fence"
+RULE_STATE_MACHINE = "state-machine"
 # not a style rule: an unparseable file cannot be checked, which must
 # surface as a finding (exit 1), never as a traceback
 RULE_PARSE_ERROR = "parse-error"
+# Not in ALL_RULES: race findings come from the dynamic detector
+# (analysis/racedetect.py via `--race`), never from the static pass, but
+# they share the Finding/severity/rule_doc machinery.
+RULE_RACE = "race"
 
 ALL_RULES = (
     RULE_BARE_LOCK,
@@ -89,6 +116,9 @@ ALL_RULES = (
     RULE_THREAD_HYGIENE,
     RULE_GUARDED_BY,
     RULE_SLEEP_POLL,
+    RULE_STATUSWRITER_BYPASS,
+    RULE_OWNERSHIP_FENCE,
+    RULE_STATE_MACHINE,
     RULE_LOCK_ORDER,
     RULE_GUARDED_INTERPROC,
     RULE_ATOMICITY,
@@ -96,7 +126,46 @@ ALL_RULES = (
 )
 
 # Schema version of the --json findings document (docs/static-analysis.md).
-FINDINGS_JSON_VERSION = 1
+# v2 adds the top-level `schema` marker and per-finding severity/rule_doc;
+# every v1 key is preserved unchanged, so v1 readers keep working.
+FINDINGS_JSON_VERSION = 2
+FINDINGS_JSON_SCHEMA = "tf-operator-tpu/lint-findings"
+
+# Warnings are smells a human should triage; everything else (and any rule
+# not listed) is an error — a correctness invariant the build gates on.
+RULE_SEVERITY = {
+    RULE_WALL_CLOCK: "warning",
+    RULE_SWALLOW: "warning",
+    RULE_THREAD_HYGIENE: "warning",
+    RULE_SLEEP_POLL: "warning",
+}
+
+
+def rule_doc(rule: str) -> str:
+    """URL-ish anchor into docs/static-analysis.md for a rule id.  The
+    dynamic explorer kinds (`race`, `explore-*`) share one section."""
+    if rule == RULE_RACE or rule.startswith("explore-"):
+        return "docs/static-analysis.md#the-race-detector"
+    return f"docs/static-analysis.md#{rule}"
+
+
+# Declared condition state machines for the `state-machine` rule: condition
+# type name -> the literal reasons allowed to set it true / flip it false.
+# Transitions on other condition types are unconstrained until a machine is
+# declared for them.
+CONDITION_STATE_MACHINES = {
+    "RESIZING": {
+        "set": {"JobResizing"},
+        "clear": {"RunningResized"},
+    },
+}
+
+# Calls the state-machine rule inspects, mapped to the transition verb.
+_CONDITION_CALLS = {
+    "update_job_conditions": "set",
+    "set_operational_condition": "set",
+    "clear_condition": "clear",
+}
 
 # Subpackages (relative to the package root) where wall-clock reads are
 # banned.  train/ and ops/ are workload-side (they run inside pods, where
@@ -202,6 +271,25 @@ class _FileChecker:
                 prev = self.stmt_header.get(line)
                 if prev is None or node.lineno > prev:  # innermost wins
                     self.stmt_header[line] = node.lineno
+        # line -> name of the innermost class whose body covers it
+        # (statuswriter-bypass exempts CoalescingStatusWriter's own body).
+        # ast.walk visits parents before nested classes, so the last
+        # writer for a line is the innermost class.
+        self.class_at_line: Dict[int, str] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ClassDef) and node.end_lineno is not None:
+                for line in range(node.lineno, node.end_lineno + 1):
+                    self.class_at_line[line] = node.name
+        # ownership-fence arms only in federated modules: anything that
+        # talks about the shard-lease manager is expected to fence its
+        # queue traffic; modules that predate federation stay untouched.
+        self.in_federated_scope = any(
+            (isinstance(node, ast.Attribute)
+             and node.attr == "shard_manager")
+            or (isinstance(node, ast.Name)
+                and node.id in ("shard_manager", "ShardLeaseManager"))
+            for node in ast.walk(self.tree)
+        )
         # Alias tracking so `import threading as th` / `from time import
         # time` cannot evade the rules the literal spellings would trip.
         # names bound by `from threading import Lock, Thread, ...` -> the
@@ -251,6 +339,7 @@ class _FileChecker:
                 self._check_swallow(node)
         self._check_timers()
         self._check_sleep_poll()
+        self._check_ownership_fence()
         self._check_guarded_module(self.tree)
         for node in ast.walk(self.tree):
             if isinstance(node, ast.ClassDef):
@@ -280,6 +369,8 @@ class _FileChecker:
         return None
 
     def _check_call(self, node: ast.Call) -> None:
+        self._check_statuswriter_bypass(node)
+        self._check_state_machine(node)
         ctor = self._threading_ctor(node.func)
         if ctor in _LOCK_CTORS:
             self._report(
@@ -374,6 +465,140 @@ class _FileChecker:
                         "cannot be named (t.name = \"tpujob-<role>\") or "
                         "made a daemon",
                     )
+
+    # -- architectural conformance -------------------------------------
+
+    @staticmethod
+    def _call_arg(node: ast.Call, index: int,
+                  kwname: str) -> Optional[ast.AST]:
+        """Positional arg `index` or keyword `kwname`, whichever the call
+        spelled; None when absent."""
+        for kw in node.keywords:
+            if kw.arg == kwname:
+                return kw.value
+        if len(node.args) > index:
+            return node.args[index]
+        return None
+
+    def _check_statuswriter_bypass(self, node: ast.Call) -> None:
+        """A status PUT (`<cluster>.update_job_status(...)`) anywhere but
+        inside CoalescingStatusWriter bypasses the coalescer: the writer's
+        last-written memory goes stale and echo suppression starts eating
+        real transitions.  Route through `status_writer.write(...)` /
+        `write_if_changed(...)` instead."""
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr == "update_job_status"):
+            return
+        receiver = func.value
+        is_cluster = (
+            (isinstance(receiver, ast.Name) and receiver.id == "cluster")
+            or (isinstance(receiver, ast.Attribute)
+                and receiver.attr == "cluster")
+        )
+        if not is_cluster:
+            # plugin/backends named otherwise (status_engine, the cluster
+            # implementations themselves) are different layers, not PUTs
+            # sneaking around the writer
+            return
+        if self.class_at_line.get(node.lineno) == "CoalescingStatusWriter":
+            return  # the sanctioned path's own body
+        self._report(
+            RULE_STATUSWRITER_BYPASS, node,
+            "status PUT bypasses CoalescingStatusWriter; route it through "
+            "status_writer.write()/write_if_changed() so coalescing and "
+            "echo suppression stay correct (runtime/statuswriter.py)",
+        )
+
+    def _mentions_work_queue(self, expr: ast.AST) -> bool:
+        return any(
+            (isinstance(n, ast.Attribute) and n.attr == "work_queue")
+            or (isinstance(n, ast.Name) and n.id == "work_queue")
+            for n in ast.walk(expr)
+        )
+
+    def _check_ownership_fence(self) -> None:
+        """In federated modules, every function that enqueues to or pops
+        from the work queue must check shard ownership (`owns()` /
+        `owns_key()`) somewhere in its body — an unfenced enqueue admits
+        keys another replica owns, an unfenced pop processes them."""
+        if not self.in_federated_scope:
+            return
+        funcs = [n for n in ast.walk(self.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in funcs:
+            body = list(self._scope_walk(fn))
+            fenced = any(
+                isinstance(n, ast.Call) and (
+                    (isinstance(n.func, ast.Attribute)
+                     and n.func.attr in ("owns", "owns_key"))
+                    or (isinstance(n.func, ast.Name)
+                        and n.func.id in ("owns", "owns_key"))
+                )
+                for n in body
+            )
+            if fenced:
+                continue
+            # vars bound from a work-queue call (`shard_queue =
+            # self.work_queue.shard(i)`) carry the taint: popping THEM is
+            # popping the queue
+            queue_vars: Set[str] = set()
+            for n in body:
+                if (isinstance(n, ast.Assign)
+                        and isinstance(n.value, ast.Call)
+                        and self._mentions_work_queue(n.value.func)):
+                    queue_vars.update(
+                        t.id for t in n.targets if isinstance(t, ast.Name))
+            for n in body:
+                if not (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("add", "get")):
+                    continue
+                receiver = n.func.value
+                if (self._mentions_work_queue(receiver)
+                        or (isinstance(receiver, ast.Name)
+                            and receiver.id in queue_vars)):
+                    self._report(
+                        RULE_OWNERSHIP_FENCE, n,
+                        f"work-queue .{n.func.attr}() in federated code "
+                        f"with no owns()/owns_key() check in "
+                        f"{fn.name}(); an unfenced path touches keys "
+                        "another replica owns — gate it (e.g. via "
+                        "_enqueue) or fence the function",
+                    )
+
+    def _check_state_machine(self, node: ast.Call) -> None:
+        """Condition transitions on a declared machine must use a declared
+        literal reason: the edge set in CONDITION_STATE_MACHINES is the
+        spec, and a novel (or non-literal) reason is an edge the machine
+        does not have."""
+        func = node.func
+        name = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else None)
+        verb = _CONDITION_CALLS.get(name or "")
+        if verb is None:
+            return
+        ctype = self._call_arg(node, 1, "ctype")
+        key = (ctype.attr if isinstance(ctype, ast.Attribute)
+               else ctype.id if isinstance(ctype, ast.Name) else None)
+        machine = CONDITION_STATE_MACHINES.get(key or "")
+        if machine is None:
+            return
+        allowed = machine[verb]
+        reason = self._call_arg(node, 2, "reason")
+        if (isinstance(reason, ast.Constant)
+                and isinstance(reason.value, str)):
+            if reason.value in allowed:
+                return
+            detail = f"undeclared reason {reason.value!r}"
+        else:
+            detail = "a non-literal reason (the edge set is uncheckable)"
+        self._report(
+            RULE_STATE_MACHINE, node,
+            f"{key} {verb} transition with {detail}; declared edges for "
+            f"{verb} are {sorted(allowed)} (CONDITION_STATE_MACHINES in "
+            "tf_operator_tpu/analysis/__init__.py)",
+        )
 
     # -- sleep-poll ----------------------------------------------------
 
@@ -849,15 +1074,21 @@ def check_package(root: str,
 
 def write_findings_json(path: str, findings: List[Finding],
                         target: str) -> None:
-    """Machine-readable findings document (schema: version, target, count,
-    findings[{rule, path, line, message}] — docs/static-analysis.md)."""
+    """Machine-readable findings document, schema v2: top-level {version,
+    schema, target, count, findings[]}, per-finding {rule, path, line,
+    message, severity, rule_doc} — docs/static-analysis.md.  Strictly
+    additive over v1 (same keys, new ones alongside), so v1 readers that
+    index version/target/count/findings keep working unchanged."""
     doc = {
         "version": FINDINGS_JSON_VERSION,
+        "schema": FINDINGS_JSON_SCHEMA,
         "target": target,
         "count": len(findings),
         "findings": [
             {"rule": f.rule, "path": f.path, "line": f.line,
-             "message": f.message}
+             "message": f.message,
+             "severity": RULE_SEVERITY.get(f.rule, "error"),
+             "rule_doc": rule_doc(f.rule)}
             for f in findings
         ],
     }
@@ -879,6 +1110,30 @@ def resolve_package_dir(spec: str) -> Tuple[str, str]:
     return root, spec.replace(".", "/") + "/"
 
 
+def race_findings(names: Sequence[str], schedules: int,
+                  seed: int = 0) -> List[Finding]:
+    """Run the registered scenarios race-checked for `schedules` seeded
+    schedules each; every failing schedule (race or otherwise) becomes a
+    Finding whose message carries the full seed/decision-trace artifact."""
+    from . import explore, scenarios
+
+    findings: List[Finding] = []
+    for name in names:
+        scenario = scenarios.SCENARIOS[name]()
+        result = explore.explore(scenario, schedules=schedules, seed=seed)
+        failure = result.failure
+        if failure is None:
+            continue
+        rule = (RULE_RACE if failure.kind == explore.FAIL_RACE
+                else f"explore-{failure.kind}")
+        findings.append(Finding(
+            rule=rule, path=f"scenario:{name}",
+            line=max(failure.schedule_index, 0),
+            message=failure.render(),
+        ))
+    return findings
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
 
@@ -898,7 +1153,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--json", default=None, metavar="PATH",
                         help="also write machine-readable findings to PATH "
                              "(schema in docs/static-analysis.md)")
+    parser.add_argument("--race", default=None, metavar="SCENARIO",
+                        help="instead of the static lint, run the "
+                             "race-checked interleaving soak over one "
+                             "registered scenario, or 'all' "
+                             "(analysis/scenarios.py)")
+    parser.add_argument("--schedules", type=int, default=None,
+                        help="schedules per scenario for --race (default: "
+                             "$ANALYSIS_EXPLORE_BUDGET, else 150)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="base seed for --race schedules (default: 0)")
     args = parser.parse_args(argv)
+
+    if args.race is not None:
+        from . import scenarios
+
+        if args.race == "all":
+            names = sorted(scenarios.SCENARIOS)
+        elif args.race in scenarios.SCENARIOS:
+            names = [args.race]
+        else:
+            known = ", ".join(sorted(scenarios.SCENARIOS))
+            raise SystemExit(
+                f"unknown scenario: {args.race!r} (known: {known}, or 'all')")
+        schedules = args.schedules
+        if schedules is None:
+            schedules = int(os.environ.get("ANALYSIS_EXPLORE_BUDGET", "150"))
+        findings = race_findings(names, schedules=schedules, seed=args.seed)
+        for finding in findings:
+            print(finding.render())
+        print(f"{len(findings)} race finding(s) over {len(names)} "
+              f"scenario(s) x {schedules} schedules")
+        if args.json is not None:
+            write_findings_json(args.json, findings,
+                                target=f"race:{args.race}")
+        return 1 if findings else 0
 
     root, prefix = resolve_package_dir(args.package)
     exclude = [d for d in (args.exclude or "").split(",") if d]

@@ -59,6 +59,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..utils import clock, locks
+from . import racedetect
 
 # A single scheduling step should be microseconds; a scenario thread that
 # fails to reach its next yield point within this many seconds is stuck in
@@ -75,6 +76,7 @@ FAIL_DEADLOCK = "deadlock"
 FAIL_EXCEPTION = "exception"
 FAIL_INVERSION = "lock-inversion"
 FAIL_BUDGET = "budget"
+FAIL_RACE = "race"
 
 
 class InvariantViolation(AssertionError):
@@ -353,56 +355,78 @@ def _run_one_schedule(scenario: Scenario,
     fake = clock.FakeClock()
     with clock.use(fake):
         with locks.instrumented() as registry:
-            state = scenario.build()
+            # The race detector observes THIS schedule only: installed
+            # before build() so constructor writes are recorded on the
+            # main thread's clock, removed before the next schedule.
+            detector = racedetect.RaceDetector()
+            locks.add_lock_watcher(detector)
+            previous_tracker = locks.set_access_tracker(detector.on_access)
             try:
-                run = _Run(scenario.threads(state))
-                previous_hook = locks.set_explore_hook(run)
-                _active_run = run
+                state = scenario.build()
                 try:
-                    outcome = run.drive(choose, max_steps)
-                finally:
-                    _active_run = None
-                    locks.set_explore_hook(previous_hook)
-                    run.abort()  # unparks what a failed schedule left blocked
-                    run.join_all()
-                if outcome is not None:
-                    return failure(outcome[0], outcome[1], run.trace)
-                for task in run.tasks:
-                    if task.error is not None:
-                        kind = (FAIL_INVARIANT
-                                if isinstance(task.error, AssertionError)
-                                else FAIL_EXCEPTION)
+                    run = _Run(scenario.threads(state))
+                    previous_hook = locks.set_explore_hook(run)
+                    _active_run = run
+                    detector.fork_barrier()  # build() writes HB thread bodies
+                    try:
+                        outcome = run.drive(choose, max_steps)
+                    finally:
+                        _active_run = None
+                        locks.set_explore_hook(previous_hook)
+                        run.abort()  # unparks what a failed schedule left blocked
+                        run.join_all()
+                        detector.join_barrier()  # thread writes HB check()
+                    if outcome is not None:
+                        return failure(outcome[0], outcome[1], run.trace)
+                    for task in run.tasks:
+                        if task.error is not None:
+                            kind = (FAIL_INVARIANT
+                                    if isinstance(task.error, AssertionError)
+                                    else FAIL_EXCEPTION)
+                            return failure(
+                                kind,
+                                f"thread {task.name}: "
+                                f"{task.error!r}\n{task.error_tb}",
+                                run.trace)
+                    if detector.races:
+                        # Checked before inversions: an unordered access
+                        # pair is the sharper diagnosis when both fire.
                         return failure(
-                            kind,
-                            f"thread {task.name}: "
-                            f"{task.error!r}\n{task.error_tb}",
+                            FAIL_RACE,
+                            "\n".join(r.render() for r in detector.races),
                             run.trace)
-                cycles = registry.inversion_cycles()
-                if cycles:
-                    return failure(
-                        FAIL_INVERSION,
-                        f"lock acquisition-order cycle(s): {cycles}",
-                        run.trace)
-                try:
-                    scenario.check(state)
-                except AssertionError as err:
-                    return failure(FAIL_INVARIANT, str(err) or repr(err),
-                                   run.trace)
-                except Exception as err:  # lint: allow(swallow) — converted to a ScheduleFailure the caller raises on
-                    # A racy schedule can corrupt state so badly check()
-                    # crashes before any assert (KeyError on a dropped
-                    # entry, say).  That is still this schedule's verdict
-                    # — keep the seed/trace artifact instead of letting a
-                    # raw traceback escape without it.
-                    return failure(
-                        FAIL_EXCEPTION,
-                        f"check() raised {err!r}\n{traceback.format_exc()}",
-                        run.trace)
+                    cycles = registry.inversion_cycles()
+                    if cycles:
+                        return failure(
+                            FAIL_INVERSION,
+                            f"lock acquisition-order cycle(s): {cycles}",
+                            run.trace)
+                    try:
+                        scenario.check(state)
+                    except AssertionError as err:
+                        return failure(FAIL_INVARIANT, str(err) or repr(err),
+                                       run.trace)
+                    except Exception as err:  # lint: allow(swallow) — converted to a ScheduleFailure the caller raises on
+                        # A racy schedule can corrupt state so badly check()
+                        # crashes before any assert (KeyError on a dropped
+                        # entry, say).  That is still this schedule's verdict
+                        # — keep the seed/trace artifact instead of letting a
+                        # raw traceback escape without it.
+                        return failure(
+                            FAIL_EXCEPTION,
+                            f"check() raised {err!r}\n{traceback.format_exc()}",
+                            run.trace)
+                finally:
+                    # Unconditional: even when drive() raised (stuck thread),
+                    # the scenario's helpers must not leak into the next
+                    # schedule — that diagnostic path needs teardown MOST.
+                    scenario.cleanup(state)
             finally:
-                # Unconditional: even when drive() raised (stuck thread),
-                # the scenario's helpers must not leak into the next
-                # schedule — that diagnostic path needs teardown MOST.
-                scenario.cleanup(state)
+                # The detector must not outlive its schedule: a leaked
+                # tracker would charge the NEXT schedule's accesses to
+                # this schedule's clocks.
+                locks.set_access_tracker(previous_tracker)
+                locks.remove_lock_watcher(detector)
     return None
 
 

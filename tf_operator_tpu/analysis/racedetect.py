@@ -1,0 +1,224 @@
+"""FastTrack-style happens-before data-race detector: the sanitizer layer.
+
+The static rules reason about annotated lock discipline and the explorer
+fails on invariants it is told to check; neither can catch a shared field
+that is simply never locked consistently.  This module closes that gap the
+way FastTrack (Flanagan & Freund, PLDI 2009) and Go's `-race` do: build the
+happens-before relation from the synchronization the program actually
+performed, and flag any pair of accesses to the same (object, field) — at
+least one a write — that the relation does not order.
+
+The model, fed entirely by the `utils.locks` seams:
+
+  - every thread `t` carries a vector clock `C_t`;
+  - every `InstrumentedLock` `m` carries a clock `L_m`: a release copies
+    `C_t` into `L_m` and ticks `C_t[t]`; an acquire joins `L_m` into `C_t`
+    — the release→acquire synchronization edge.  The events arrive via the
+    `locks.add_lock_watcher` chain, which fires on every acquire/release
+    regardless of which thread the explorer hook manages;
+  - `locks.track_access(obj, field, is_write)` (and the `@shared_state`
+    decorator that calls it) records read/write epochs per (object,
+    field).  A write must happen-after the previous write and every
+    recorded read; a read must happen-after the previous write;
+  - the explorer contributes fork/join edges (`fork_barrier` before it
+    starts scenario threads, `join_barrier` after it joins them) so
+    single-threaded setup in `Scenario.build()` and the post-schedule
+    `Scenario.check()` never read as racing with the scenario threads.
+
+One detector instance per explored schedule (`analysis/explore.py` wires
+it into every schedule; a detected race is a first-class `FAIL_RACE`
+failure artifact with the same seed/decision-trace replay as a deadlock).
+A variable reports at most one race and is then retired — FastTrack's
+first-race-per-variable policy keeps reports readable.
+
+Thread identities are `threading.get_ident()` values, labeled with the
+thread's name at its first event so reports read "tpujob-explore-writer-b"
+rather than an integer.
+"""
+from __future__ import annotations
+
+import sys
+import threading
+from dataclasses import dataclass, field as dataclass_field
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import locks
+
+# Frames from these files are skipped when attributing an access to a
+# source location: the seam and the detector are plumbing, not the access.
+_PLUMBING_SUFFIXES = ("utils/locks.py", "analysis/racedetect.py")
+
+
+def _join(into: Dict[int, int], other: Dict[int, int]) -> None:
+    """Pointwise max: `into` ⊔= `other`."""
+    for ident, clk in other.items():
+        if clk > into.get(ident, 0):
+            into[ident] = clk
+
+
+@dataclass
+class _VarState:
+    """Per-(object, field) access history."""
+    label: str                      # "ClassName.field" for reports
+    write: Optional[Tuple[int, int, str, str]] = None  # (ident, clk, thread, site)
+    reads: Dict[int, Tuple[int, str, str]] = dataclass_field(
+        default_factory=dict)   # ident -> (clk, thread name, site)
+    retired: bool = False           # one race per variable, then silence
+
+
+@dataclass(frozen=True)
+class RaceReport:
+    var: str        # "ClassName.field"
+    kind: str       # "write-write" | "read-write" | "write-read"
+    current_op: str
+    current_thread: str
+    current_site: str
+    prior_op: str
+    prior_thread: str
+    prior_site: str
+
+    def render(self) -> str:
+        return (
+            f"data race on {self.var} ({self.kind}): "
+            f"{self.current_op} by {self.current_thread} at "
+            f"{self.current_site} is unordered with {self.prior_op} by "
+            f"{self.prior_thread} at {self.prior_site} — no lock or "
+            "fork/join edge orders the two accesses"
+        )
+
+
+class RaceDetector(locks.LockWatcher):
+    """One schedule's happens-before state.  Install with
+    `locks.add_lock_watcher(det)` + `locks.set_access_tracker(det.on_access)`;
+    inspect `det.races` after the run."""
+
+    def __init__(self) -> None:
+        # Raw lock: the detector is called from inside InstrumentedLock
+        # operations, so taking an instrumented lock here would recurse
+        # into the watcher chain.
+        self._meta = threading.Lock()  # lint: allow(bare-lock) — detector internals, see comment
+        self._clocks: Dict[int, Dict[int, int]] = {}   # guarded-by: _meta
+        self._lock_clocks: Dict[int, Dict[int, int]] = {}  # guarded-by: _meta
+        self._vars: Dict[Tuple[int, str], _VarState] = {}  # guarded-by: _meta
+        # Strong refs to every tracked object: id() keys must stay unique
+        # for the schedule's lifetime, so no tracked object may be
+        # collected (and its id reused) mid-schedule.
+        self._pins: List[object] = []  # guarded-by: _meta
+        self._names: Dict[int, str] = {}  # guarded-by: _meta
+        # Vector clock new threads are born with (the fork edge): set by
+        # fork_barrier to the forking thread's clock at that instant.
+        self._origin: Dict[int, int] = {}  # guarded-by: _meta
+        self.races: List[RaceReport] = []  # guarded-by: _meta
+
+    # -- clock plumbing (all under _meta) ------------------------------
+
+    # requires-lock: _meta
+    def _clock(self, ident: int) -> Dict[int, int]:
+        clock = self._clocks.get(ident)
+        if clock is None:
+            clock = dict(self._origin)
+            clock[ident] = clock.get(ident, 0) + 1
+            self._clocks[ident] = clock
+            self._names[ident] = threading.current_thread().name
+        return clock
+
+    def fork_barrier(self) -> None:
+        """Record the calling thread's clock as the birth clock of every
+        thread first seen afterwards: writes the caller performed so far
+        happen-before everything those threads do."""
+        ident = threading.get_ident()
+        with self._meta:
+            clock = self._clock(ident)
+            self._origin = dict(clock)
+            clock[ident] += 1
+
+    def join_barrier(self) -> None:
+        """Join every known thread's clock into the calling thread's:
+        everything the joined threads did happens-before what the caller
+        does next (the explorer calls this after join_all, so
+        `Scenario.check` reads are ordered after scenario-thread writes)."""
+        ident = threading.get_ident()
+        with self._meta:
+            clock = self._clock(ident)
+            for other_ident, other in self._clocks.items():
+                if other_ident != ident:
+                    _join(clock, other)
+
+    # -- locks.LockWatcher surface -------------------------------------
+
+    def on_acquired(self, lock) -> None:
+        ident = threading.get_ident()
+        with self._meta:
+            _join(self._clock(ident), self._lock_clocks.get(id(lock), {}))
+
+    def on_released(self, lock) -> None:
+        ident = threading.get_ident()
+        with self._meta:
+            clock = self._clock(ident)
+            self._lock_clocks[id(lock)] = dict(clock)
+            clock[ident] += 1
+
+    # -- the access seam (locks.set_access_tracker target) -------------
+
+    def on_access(self, obj: object, field: str, is_write: bool) -> None:
+        ident = threading.get_ident()
+        site = _access_site()
+        with self._meta:
+            clock = self._clock(ident)
+            key = (id(obj), field)
+            var = self._vars.get(key)
+            if var is None:
+                var = _VarState(label=f"{type(obj).__name__}.{field}")
+                self._vars[key] = var
+                self._pins.append(obj)
+            if var.retired:
+                return
+            name = self._names[ident]
+            if is_write:
+                race = self._check_write(var, ident, clock, name, site)
+            else:
+                race = self._check_read(var, ident, clock, name, site)
+            if race is not None:
+                var.retired = True
+                self.races.append(race)
+
+    # requires-lock: _meta
+    def _check_write(self, var: _VarState, ident: int,
+                     clock: Dict[int, int], name: str,
+                     site: str) -> Optional[RaceReport]:
+        if var.write is not None:
+            w_ident, w_clk, w_name, w_site = var.write
+            if w_clk > clock.get(w_ident, 0):
+                return RaceReport(var.label, "write-write", "write", name,
+                                  site, "write", w_name, w_site)
+        for r_ident, (r_clk, r_name, r_site) in var.reads.items():
+            if r_ident != ident and r_clk > clock.get(r_ident, 0):
+                return RaceReport(var.label, "read-write", "write", name,
+                                  site, "read", r_name, r_site)
+        var.write = (ident, clock[ident], name, site)
+        var.reads.clear()
+        return None
+
+    # requires-lock: _meta
+    def _check_read(self, var: _VarState, ident: int,
+                    clock: Dict[int, int], name: str,
+                    site: str) -> Optional[RaceReport]:
+        if var.write is not None:
+            w_ident, w_clk, w_name, w_site = var.write
+            if w_clk > clock.get(w_ident, 0):
+                return RaceReport(var.label, "write-read", "read", name,
+                                  site, "write", w_name, w_site)
+        var.reads[ident] = (clock[ident], name, site)
+        return None
+
+
+def _access_site() -> str:
+    """file:line of the access being tracked — the first frame below the
+    locks/racedetect plumbing."""
+    frame = sys._getframe(1)
+    while frame is not None:
+        filename = frame.f_code.co_filename.replace("\\", "/")
+        if not filename.endswith(_PLUMBING_SUFFIXES):
+            return f"{filename.rsplit('/', 1)[-1]}:{frame.f_lineno}"
+        frame = frame.f_back
+    return "<unknown>"

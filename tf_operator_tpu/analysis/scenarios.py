@@ -1,0 +1,158 @@
+"""Registered explorer scenarios for the standalone race soak.
+
+`python -m tf_operator_tpu.analysis --race <name|all>` runs these under the
+race-checked interleaving explorer (analysis/explore.py); CI's lint tier
+sweeps them with a bounded schedule budget and records `race-findings.json`
+(build/run_tests.py).  The deep scenario library lives in
+`tests/test_schedule_explorer.py` — this registry holds the lean,
+real-code, in-package scenarios the soak and CI can reach without
+importing the test tree.
+
+The elastic-resize scenario drives the PR 16 control-plane surfaces that
+carry `@shared_state` / `track_access` instrumentation: two jobs resize
+concurrently through the shared `CoalescingStatusWriter` and the
+module-global virtual-replica gauge state, each cycling the declared
+Resizing→RunningResized condition arc.  Every schedule is race-checked;
+the post-schedule invariant pins wire-vs-memory consistency per key.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..api.core import ObjectMeta
+from ..api.types import JobConditionType, JobStatus
+from ..runtime import conditions, reconciler, statuswriter
+from ..utils import locks
+from . import explore
+
+
+class _Job:
+    """Minimal TPUJob stand-in: metadata + status + key(), nothing more —
+    the writer and condition helpers only touch these."""
+
+    def __init__(self, namespace: str, name: str) -> None:
+        self.metadata = ObjectMeta(name=name, namespace=namespace)
+        self.status = JobStatus()
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+class _SinkCluster:
+    """Records every status PUT the writer sends, newest last per key."""
+
+    def __init__(self) -> None:
+        self._lock = locks.new_lock("race-sink")
+        # key -> snapshots of every PUT status, in wire order
+        self.puts: Dict[str, List[Tuple]] = {}  # guarded-by: _lock
+
+    def update_job_status(self, namespace: str, name: str, status) -> None:
+        snapshot = statuswriter.snapshot_status(status)
+        with self._lock:
+            self.puts.setdefault(f"{namespace}/{name}", []).append(snapshot)
+
+    def last_put(self, key: str):
+        with self._lock:
+            entries = self.puts.get(key)
+            return entries[-1] if entries else None
+
+    def total_puts(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self.puts.values())
+
+
+class _ElasticResizeState:
+    def __init__(self) -> None:
+        self.sink = _SinkCluster()
+        self.writer = statuswriter.CoalescingStatusWriter(self.sink)
+        self.jobs = [_Job("race", "elastic-a"), _Job("race", "elastic-b")]
+        # The gauge lock is module-level, built at import time — OUTSIDE
+        # the schedule's `locks.instrumented()` block — so it is a raw
+        # lock the detector cannot see happens-before edges through.
+        # Swap in an instrumented twin for the schedule (restored in
+        # cleanup): the detector then verifies the real locking
+        # discipline — drop the `with _virtual_replica_lock:` from
+        # _publish_virtual_replicas and this scenario reports the race.
+        self.original_gauge_lock = reconciler._virtual_replica_lock
+        reconciler._virtual_replica_lock = locks.new_lock(
+            "virtual-replica-gauge")
+
+
+class ElasticResizeRaceScenario(explore.Scenario):
+    """Two jobs resize concurrently through the shared writer + gauge
+    state.  DIFFERENT keys per thread: the writer's per-key-exclusivity
+    assumption (shard ownership keeps replicas off each other's keys,
+    runtime/statuswriter.py) is part of the design being checked, not a
+    restriction to dodge."""
+
+    name = "elastic-resize"
+    cycles = 2
+
+    def build(self) -> _ElasticResizeState:
+        return _ElasticResizeState()
+
+    def _resize_cycles(self, state: _ElasticResizeState, job: _Job) -> None:
+        key = job.key()
+        for generation in range(self.cycles):
+            old = statuswriter.snapshot_status(job.status)
+            conditions.update_job_conditions(
+                job.status, JobConditionType.RESIZING, "JobResizing",
+                f"resize generation {generation}")
+            reconciler._publish_virtual_replicas(key, 1, 1)
+            explore.yield_point()
+            state.writer.write_if_changed(job, old)
+            explore.yield_point()
+            old = statuswriter.snapshot_status(job.status)
+            conditions.clear_condition(
+                job.status, JobConditionType.RESIZING, "RunningResized",
+                "resized gang running")
+            reconciler._publish_virtual_replicas(key, 2, 0)
+            explore.yield_point()
+            state.writer.write_if_changed(job, old)
+
+    def threads(self, state: _ElasticResizeState):
+        return [
+            (f"resize-{job.metadata.name}",
+             lambda job=job: self._resize_cycles(state, job))
+            for job in state.jobs
+        ]
+
+    def check(self, state: _ElasticResizeState) -> None:
+        total = 0
+        for job in state.jobs:
+            key = job.key()
+            wire = state.sink.last_put(key)
+            if wire is None:
+                raise explore.InvariantViolation(f"no PUT reached {key}")
+            # The writer's memory of "what the wire holds" must match the
+            # last PUT that actually went out — the invariant coalescing
+            # rule 3 (stale-read echo suppression) stands on.
+            with state.writer._lock:
+                remembered = state.writer._last.get(key)
+            if remembered != wire:
+                raise explore.InvariantViolation(
+                    f"writer memory for {key} diverged from the wire: "
+                    f"remembered {remembered!r}, wire holds {wire!r}")
+            if wire != statuswriter.snapshot_status(job.status):
+                raise explore.InvariantViolation(
+                    f"final status of {key} never reached the wire")
+        for job in state.jobs:
+            total += len(state.sink.puts.get(job.key(), ()))
+        if state.writer.counters()["writes"] != total:
+            raise explore.InvariantViolation(
+                f"writer counted {state.writer.counters()['writes']} "
+                f"writes, the wire saw {total}")
+
+    def cleanup(self, state: _ElasticResizeState) -> None:
+        # The gauge dict is module-global: drop this schedule's keys so
+        # the next schedule (and the rest of the process) starts clean,
+        # then put the original module lock back.
+        for job in state.jobs:
+            reconciler._publish_virtual_replicas(job.key(), None, 0)
+        reconciler._virtual_replica_lock = state.original_gauge_lock
+
+
+# name -> zero-arg scenario factory, the `--race` registry.
+SCENARIOS = {
+    ElasticResizeRaceScenario.name: ElasticResizeRaceScenario,
+}

@@ -288,7 +288,14 @@ class TPUJobController(JobPlugin):
                         except NotFound:
                             pass
                 else:
-                    self.work_queue.add(key)
+                    # Through the owns_key-gated _enqueue, not a bare
+                    # work_queue.add: the lease can bounce between the
+                    # key scan above and this enqueue (rebalance against
+                    # a returning peer), and an unfenced add would queue
+                    # a key whose shard we no longer own — the new owner
+                    # re-enqueues it on ITS adoption, so dropping here is
+                    # the correct half of the handoff.
+                    self._enqueue(key)
         except Exception as err:  # noqa: BLE001 — next resync tick re-covers the shard
             tpulog.logger_for_key("shardlease").warning(
                 "adoption enqueue of shard %d failed: %s", shard, err)

@@ -166,6 +166,10 @@ def _publish_virtual_replicas(
     """Record one job's virtual-replica split and republish the fleet sums.
     mapped=None drops the job (terminal/deleted)."""
     with _virtual_replica_lock:
+        # Access seam for the dynamic race detector: the dict is shared
+        # across every reconciling thread, and this one call marks the
+        # whole read-modify-republish as a write access to it.
+        locks.track_access(_virtual_replica_states, "entries", True)
         if mapped is None:
             _virtual_replica_states.pop(job_key, None)
         else:

@@ -102,7 +102,7 @@ class FakeSliceProvider(SliceProvider):
     """
 
     def __init__(self, inventory: Dict[Tuple[str, str], int]) -> None:
-        self._slices: List[Slice] = []
+        self._slices: List[Slice] = []  # guarded-by: _lock
         for (accelerator, topology), count in sorted(inventory.items()):
             for i in range(count):
                 self._slices.append(
@@ -110,7 +110,7 @@ class FakeSliceProvider(SliceProvider):
                           accelerator, topology)
                 )
         self._lock = locks.new_lock("slice-provider")
-        self._watchers: List[SliceWatchHandler] = []
+        self._watchers: List[SliceWatchHandler] = []  # guarded-by: _lock
 
     # -- SliceProvider --
 
@@ -164,7 +164,8 @@ class FakeSliceProvider(SliceProvider):
             return list(self._slices)
 
     def watch(self, handler: SliceWatchHandler) -> None:
-        self._watchers.append(handler)
+        with self._lock:
+            self._watchers.append(handler)
 
     # -- fault injection (test-server analogue for the fabric) --
 
@@ -183,7 +184,9 @@ class FakeSliceProvider(SliceProvider):
             if s.state == SliceState.PREEMPTED:
                 return s
             s.state = SliceState.PREEMPTED
-        for handler in list(self._watchers):
+            watchers = list(self._watchers)
+        # dispatch outside the lock: handlers call back into schedulers
+        for handler in watchers:
             handler(s, "preempted")
         return s
 
@@ -213,7 +216,8 @@ class FakeSliceProvider(SliceProvider):
                 return s
             s.state = SliceState.FREE
             s.holder = None
-        for handler in list(self._watchers):
+            watchers = list(self._watchers)
+        for handler in watchers:
             handler(s, "repaired")
         return s
 

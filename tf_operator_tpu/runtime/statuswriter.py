@@ -77,11 +77,17 @@ def _transition_count(old: Optional[Tuple], new: Tuple) -> int:
     return max(1, transitions)
 
 
+@locks.shared_state
 class CoalescingStatusWriter:
     """The one path every TPUJob status PUT takes (rules in the module
     docstring).  One instance per controller replica; shard ownership
     (runtime/shardlease.py) keeps replicas from writing the same key, and
-    `forget`/`forget_where` drop snapshots whose keys changed hands."""
+    `forget`/`forget_where` drop snapshots whose keys changed hands.
+
+    `@shared_state`: one writer is shared by every worker thread, so its
+    fields feed the dynamic race detector (analysis/racedetect.py) when a
+    tracker is installed; in production the decorator costs one global
+    read per attribute operation."""
 
     def __init__(self, cluster) -> None:
         self.cluster = cluster

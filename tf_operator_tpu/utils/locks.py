@@ -22,13 +22,30 @@ instrumented locks; everything built outside keeps raw ones.  Tests wrap
 the *construction* of the system under test, not each use.  Conditions are
 named but never instrumented — wait/notify semantics require the raw
 primitive's owner bookkeeping.
+
+Two further seams feed the dynamic race detector
+(`tf_operator_tpu.analysis.racedetect`, docs/static-analysis.md):
+
+  - **Lock-event watchers.**  `add_lock_watcher(w)` registers a passive
+    observer of every InstrumentedLock acquire/release.  The event chain
+    on each operation is explicit and deterministic (see
+    `InstrumentedLock.acquire`/`release`): the explorer hook schedules,
+    the registry records, then every watcher fires in registration order
+    — so race tracking under the explorer can never silently drop a lock
+    event to hook-slot replacement.
+  - **Access tracking.**  `track_access(obj, field, is_write)` reports a
+    shared-state read/write to the installed tracker (a no-op costing one
+    global read when none is installed — production never installs one).
+    The `@shared_state` class decorator wires it automatically for every
+    instance attribute of hot control-plane classes; explicit calls cover
+    module-level structures.
 """
 from __future__ import annotations
 
 import threading
 import time
 from contextlib import contextmanager
-from typing import Dict, Iterator, List, Optional, Set, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Set, Tuple
 
 from . import graph
 
@@ -66,6 +83,101 @@ def set_explore_hook(hook: Optional[ExploreHook]) -> Optional[ExploreHook]:
     previous = _explore_hook
     _explore_hook = hook
     return previous
+
+
+class LockWatcher:
+    """Protocol for passive lock-event observers (duck-typed; the race
+    detector implements it).  Watchers fire for EVERY InstrumentedLock
+    operation — explorer-managed threads and foreign threads alike — and
+    `on_released` fires while the lock is still held, so the release event
+    is ordered before any subsequent acquire of the same lock."""
+
+    def on_acquired(self, lock: "InstrumentedLock") -> None:  # pragma: no cover - protocol
+        raise NotImplementedError
+
+    def on_released(self, lock: "InstrumentedLock") -> None:  # pragma: no cover - protocol
+        raise NotImplementedError
+
+
+# Registration-ordered watcher chain.  A tuple (replaced wholesale, never
+# mutated) so readers on the hot path iterate a consistent snapshot without
+# a lock.
+_lock_watchers: Tuple[LockWatcher, ...] = ()
+
+
+def add_lock_watcher(watcher: LockWatcher) -> None:
+    """Append `watcher` to the lock-event chain (fires after any earlier
+    registrations — deterministic order)."""
+    global _lock_watchers
+    _lock_watchers = _lock_watchers + (watcher,)
+
+
+def remove_lock_watcher(watcher: LockWatcher) -> None:
+    """Remove `watcher` from the chain (identity match; a no-op when it is
+    not registered)."""
+    global _lock_watchers
+    _lock_watchers = tuple(w for w in _lock_watchers if w is not watcher)
+
+
+# Shared-state access seam (the race detector's read/write feed).  One
+# tracker at a time, like the explore hook; `track_access` costs a single
+# global read when none is installed, so the seam can sit on hot paths.
+_access_tracker: Optional[Callable[[object, str, bool], None]] = None
+
+
+def set_access_tracker(
+    tracker: Optional[Callable[[object, str, bool], None]],
+) -> Optional[Callable[[object, str, bool], None]]:
+    """Install `tracker(obj, field, is_write)` as the process-wide access
+    seam; returns the previous tracker so callers can restore it."""
+    global _access_tracker
+    previous = _access_tracker
+    _access_tracker = tracker
+    return previous
+
+
+def track_access(obj: object, field: str, is_write: bool) -> None:
+    """Report a read (`is_write=False`) or write of `obj.field` to the
+    installed access tracker.  Call sites mark the shared mutable state of
+    hot control-plane structures (module-level registries, say) that the
+    `@shared_state` decorator cannot cover."""
+    tracker = _access_tracker
+    if tracker is not None:
+        tracker(obj, field, is_write)
+
+
+def shared_state(cls):
+    """Class decorator: report every instance-attribute read/write of the
+    class through `track_access`.  Opt-in for hot control-plane classes
+    whose fields the race detector should watch; with no tracker installed
+    the overhead is one global read per attribute operation.
+
+    Reads are only reported for attributes present in the instance
+    `__dict__` — method lookups and class attributes resolve through the
+    type and are not shared mutable state."""
+    orig_setattr = cls.__setattr__
+    orig_getattribute = cls.__getattribute__
+
+    def __setattr__(self, name: str, value) -> None:
+        if _access_tracker is not None and not name.startswith("__"):
+            track_access(self, name, True)
+        orig_setattr(self, name, value)
+
+    def __getattribute__(self, name: str):
+        value = orig_getattribute(self, name)
+        if _access_tracker is not None and not name.startswith("__"):
+            try:
+                is_instance_field = name in orig_getattribute(self, "__dict__")
+            except AttributeError:
+                is_instance_field = False
+            if is_instance_field:
+                track_access(self, name, False)
+        return value
+
+    cls.__setattr__ = __setattr__
+    cls.__getattribute__ = __getattribute__
+    cls.__shared_state__ = True
+    return cls
 
 
 def new_lock(name: str) -> "threading.Lock | InstrumentedLock":
@@ -119,11 +231,25 @@ class InstrumentedLock:
             got = self._inner.acquire(blocking, timeout)
         if got:
             self._hold_depth += 1
+            # Explicit post-acquire chain, deterministic order: the
+            # registry's order/hold bookkeeping first, then every watcher
+            # in registration order.  Both always fire — hook-managed and
+            # raw acquires alike — so the race detector sees the same
+            # event stream the inversion registry does.
             self._registry._on_acquire(self.name)
+            for watcher in _lock_watchers:
+                watcher.on_acquired(self)
         return got
 
     def release(self) -> None:
+        # Release chain mirrors acquire: registry, then watchers IN
+        # REGISTRATION ORDER while the lock is still held (the release
+        # event must be ordered before any successor's acquire — the
+        # happens-before edge racedetect builds on), then the raw release,
+        # then the explorer hook's scheduling point.
         self._registry._on_release(self.name)
+        for watcher in _lock_watchers:
+            watcher.on_released(self)
         self._hold_depth -= 1
         self._inner.release()
         hook = _explore_hook
