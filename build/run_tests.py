@@ -91,7 +91,13 @@ def run_lint_tier(junit_dir: str, paths: list[str]) -> int:
     budget — ANALYSIS_EXPLORE_BUDGET if set, else 150 — writing
     `race-findings.json` next to `lint-findings.json`.  Race findings are
     deterministic (seeded schedules), so like static findings they get no
-    retries."""
+    retries.
+
+    The default run also regenerates the interface manifest
+    (`--manifest`, docs/static-analysis.md#interface-manifest) into
+    `interface-manifest.json` next to the findings documents and
+    diff-gates it against the committed docs/interface-manifest.json --
+    contract drift fails the tier exactly like a finding would."""
     if paths:
         targets = [(p if os.path.isabs(p) else os.path.join(ROOT, p), [])
                    for p in paths]
@@ -120,6 +126,8 @@ def run_lint_tier(junit_dir: str, paths: list[str]) -> int:
         print("+", " ".join(cmd), flush=True)
         rc |= subprocess.call(cmd, cwd=ROOT, env=env)
     race_schedules = None
+    manifest_json = None
+    manifest_diff = None
     if not paths:
         race_schedules = int(os.environ.get("ANALYSIS_EXPLORE_BUDGET", "150"))
         race_json = os.path.join(junit_dir, "race-findings.json")
@@ -129,11 +137,23 @@ def run_lint_tier(junit_dir: str, paths: list[str]) -> int:
                "--json", race_json]
         print("+", " ".join(cmd), flush=True)
         rc |= subprocess.call(cmd, cwd=ROOT, env=env)
+        # regenerate the interface manifest and gate on the committed
+        # snapshot: an unreviewed contract change is a failure, not a diff
+        manifest_json = os.path.join(junit_dir, "interface-manifest.json")
+        committed = os.path.join(REPO, "docs", "interface-manifest.json")
+        cmd = [sys.executable, "-m", "tf_operator_tpu.analysis",
+               "--manifest", "--json", manifest_json, "--diff", committed]
+        print("+", " ".join(cmd), flush=True)
+        manifest_rc = subprocess.call(cmd, cwd=ROOT, env=env)
+        manifest_diff = "clean" if manifest_rc == 0 else "drift"
+        rc |= manifest_rc
     status = "pass" if rc == 0 else "fail"
     with open(os.path.join(junit_dir, "lint-summary.json"), "w") as f:
         json.dump({"tier": "lint", "attempts": 1, "status": status,
                    "targets": [t for t, _extra in targets],
                    "race_schedules": race_schedules,
+                   "manifest_json": manifest_json,
+                   "manifest_diff": manifest_diff,
                    "findings_json": findings_json}, f, indent=2)
     print(f"RESULT tier=lint attempts=1 status={status}", flush=True)
     return 0 if rc == 0 else 1

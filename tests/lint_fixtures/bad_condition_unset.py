@@ -1,0 +1,15 @@
+"""Known-bad fixture: rule `state-machine` must fire exactly once
+(line 10): JobConditionType.PAUSED is declared but never set at any
+condition-write site.  ACTIVE is set below — and its type has no machine
+in CONDITION_STATE_MACHINES, so the write itself is unconstrained."""
+import enum
+
+
+class JobConditionType(str, enum.Enum):
+    ACTIVE = "Active"
+    PAUSED = "Paused"
+
+
+def activate(status, update_job_conditions):
+    update_job_conditions(
+        status, JobConditionType.ACTIVE, "Activated", "fixture")

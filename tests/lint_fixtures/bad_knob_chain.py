@@ -1,0 +1,13 @@
+"""Known-bad fixture: rule `knob-chain` must fire exactly once (line 9):
+TPUJOB_ORPHAN_KNOB is produced (stored into a pod env) but nothing in the
+tree ever consumes it.  TPUJOB_LIVE_KNOB is produced AND consumed, so it
+is clean."""
+
+
+def inject(env):
+    env["TPUJOB_LIVE_KNOB"] = "1"
+    env["TPUJOB_ORPHAN_KNOB"] = "1"
+
+
+def consume(env):
+    return env.get("TPUJOB_LIVE_KNOB")

@@ -1,0 +1,16 @@
+"""Known-bad fixture: rule `metric-doc` must fire exactly once (line 14):
+tpujob_orphan_total is emitted but not documented (in single-file fixture
+mode no monitoring doc is attached, so every non-exempt emitted tpujob_*
+metric counts as undocumented).  The second registration is exempted as
+bench-local with a why-comment."""
+
+
+class _Registry:
+    def counter(self, name, help_text, label_names=()):
+        return name
+
+
+REGISTRY = _Registry()
+ORPHAN = REGISTRY.counter("tpujob_orphan_total", "never documented")
+# bench-local scratch metric, intentionally undocumented
+SCRATCH = REGISTRY.counter("tpujob_scratch_total", "bench only")  # contract: exempt(metric-doc)

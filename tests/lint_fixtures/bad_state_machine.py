@@ -18,5 +18,6 @@ def resize_declared(status, conditions, JobConditionType):
 
 
 def unconstrained(status, conditions, JobConditionType):
+    # PAUSED is not a declared machine, so any reason is allowed
     conditions.update_job_conditions(
-        status, JobConditionType.RUNNING, "AnyReasonAtAll", "no machine")
+        status, JobConditionType.PAUSED, "AnyReasonAtAll", "no machine")

@@ -1,0 +1,21 @@
+"""Known-bad fixture: rule `wire-roundtrip` must fire exactly once
+(line 11): Msg.half is serialized by msg_to_dict but never restored by
+msg_from_dict.  Msg.both round-trips in both directions, and Msg.scratch
+is explicitly exempted with a why-comment."""
+from dataclasses import dataclass
+
+
+@dataclass
+class Msg:
+    both: int = 0
+    half: int = 0
+    # backend-owned scratch value, intentionally not on the wire
+    scratch: int = 0  # contract: exempt(wire-roundtrip)
+
+
+def msg_to_dict(m: Msg) -> dict:
+    return {"both": m.both, "half": m.half, "scratch": m.scratch}
+
+
+def msg_from_dict(data: dict) -> Msg:
+    return Msg(both=data["both"])

@@ -22,3 +22,37 @@ def quietly(op):
 
 def spawn(fn):
     return threading.Thread(target=fn)  # lint: allow(thread-hygiene) — fixture
+
+
+# contract-drift rules are suppressible the same way at the reporting site
+import enum
+from dataclasses import dataclass
+
+
+@dataclass
+class Wire:
+    lopsided: int = 0  # lint: allow(wire-roundtrip) — fixture
+
+
+def wire_to_dict(w: Wire) -> dict:
+    return {"lopsided": w.lopsided}
+
+
+def wire_from_dict(data: dict) -> Wire:
+    return Wire()
+
+
+def inject(env):
+    env["TPUJOB_SUPPRESSED_KNOB"] = "1"  # lint: allow(knob-chain) — fixture
+
+
+class _Registry:
+    def counter(self, name, help_text, label_names=()):
+        return name
+
+
+METRIC = _Registry().counter("tpujob_suppressed_total", "x")  # lint: allow(metric-doc) — fixture
+
+
+class JobConditionType(str, enum.Enum):
+    DORMANT = "Dormant"  # lint: allow(state-machine) — fixture

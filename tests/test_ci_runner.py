@@ -104,6 +104,15 @@ def test_lint_tier_passes_on_clean_repo_package(tmp_path):
     race_doc = json.loads(
         (tmp_path / "junit" / "race-findings.json").read_text())
     assert race_doc["target"] == "race:all"
+    # the default run also regenerates the interface manifest and gates
+    # it against the committed docs/interface-manifest.json snapshot
+    assert summary["manifest_json"] \
+        == str(tmp_path / "junit" / "interface-manifest.json")
+    assert summary["manifest_diff"] == "clean"
+    manifest = json.loads(Path(summary["manifest_json"]).read_text())
+    assert manifest["version"] == 1
+    assert manifest["schema"] == "tf-operator-tpu/interface-manifest"
+    assert "interface manifest matches" in proc.stdout
     assert not (tmp_path / "junit" / "lint.xml").exists()
 
 
@@ -123,6 +132,9 @@ def test_lint_tier_fails_on_findings(tmp_path):
     summary = json.loads(
         (tmp_path / "junit" / "lint-summary.json").read_text())
     assert summary["status"] == "fail"
+    # explicit-paths mode runs no race sweep and no manifest gate
+    assert summary["manifest_json"] is None
+    assert summary["manifest_diff"] is None
     # the failing finding is in the uploaded machine-readable document too
     doc = json.loads(
         (tmp_path / "junit" / "lint-findings.json").read_text())

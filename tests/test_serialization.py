@@ -204,106 +204,146 @@ def test_mini_yaml_fallback():
 
 
 # ---------------------------------------------------------------------------
-# property-based: to_dict . from_dict is a fixpoint on the manifest space
+# manifest-driven exhaustive round trip: every wire field the contract
+# extractor (tf_operator_tpu/analysis/contract.py) found must survive
+# dict -> object -> dict exactly, exercised with a NON-DEFAULT value
 
 
-import pytest
+def _maximal_job():
+    """A TPUJob with every manifest-covered wire field set non-default."""
+    from tf_operator_tpu.api.core import (
+        Container, ContainerPort, EnvVar, ObjectMeta, PodTemplateSpec)
+    from tf_operator_tpu.api.types import (
+        ElasticPolicy, JobCondition, JobConditionType, JobStatus,
+        ReplicaSpec, ReplicaStatus, RunPolicy, SchedulingPolicy,
+        SuccessPolicy, TPUJob, TPUJobSpec, TPUTopology)
 
-hypothesis = pytest.importorskip(
-    "hypothesis")  # not in the CI workflow's install list
-from hypothesis import given, settings  # noqa: E402
-from hypothesis import strategies as st  # noqa: E402
-
-_name = st.text(alphabet="abcdefghijklmnopqrstuvwxyz0123456789",
-                min_size=1, max_size=12)
-_rtypes = st.sampled_from(["Worker", "PS", "Chief", "Master", "Evaluator"])
-
-
-@st.composite
-def _replica_spec(draw):
-    spec = {
-        "replicas": draw(st.integers(min_value=0, max_value=8)),
-        "restartPolicy": draw(st.sampled_from(
-            ["Never", "Always", "OnFailure", "ExitCode"])),
-        "template": {"spec": {"containers": [{
-            "name": "tensorflow",
-            "image": draw(_name),
-            **({"command": draw(st.lists(_name, min_size=1, max_size=3))}
-               if draw(st.booleans()) else {}),
-            **({"env": [{"name": draw(_name).upper(),
-                         "value": draw(_name)}]}
-               if draw(st.booleans()) else {}),
-        }]}},
-    }
-    if draw(st.booleans()):
-        spec["tpu"] = {
-            "accelerator": draw(st.sampled_from(
-                ["v5litepod-8", "v5litepod-32", "v6e-64"])),
-            "topology": draw(st.sampled_from(["2x4", "4x8", "8x8"])),
-            **({"mesh": {"dp": 2, "tp": 4}} if draw(st.booleans()) else {}),
-        }
-    return spec
-
-
-@st.composite
-def _job_dict(draw):
-    rtypes = draw(st.lists(_rtypes, min_size=1, max_size=3, unique=True))
-    d = {
-        "apiVersion": "tpu-operator.dev/v1",
-        "kind": "TPUJob",
-        "metadata": {
-            "name": draw(_name),
-            "namespace": draw(_name),
-            **({"labels": draw(st.dictionaries(_name, _name, max_size=2))}
-               if draw(st.booleans()) else {}),
-        },
-        "spec": {
-            "replicaSpecs": {rt: draw(_replica_spec()) for rt in rtypes},
-            # canonical native schema nests run-policy fields under
-            # runPolicy; the reference's inline spellings are accepted on
-            # parse but canonicalized (see the alias-equivalence test)
-            **({"runPolicy": {
-                "backoffLimit": draw(st.integers(min_value=0, max_value=10)),
-                **({"cleanPodPolicy": draw(st.sampled_from(
-                    ["Running", "All", "None"]))}
-                   if draw(st.booleans()) else {}),
-            }} if draw(st.booleans()) else {}),
-        },
-    }
-    return d
-
-
-def _assert_subset(expected, actual, path="$"):
-    """Every field of `expected` must survive into `actual` with the same
-    value (the serializer may ADD defaulted fields, never drop or change
-    one)."""
-    if isinstance(expected, dict):
-        assert isinstance(actual, dict), f"{path}: {actual!r}"
-        for k, v in expected.items():
-            assert k in actual, f"{path}.{k} dropped"
-            _assert_subset(v, actual[k], f"{path}.{k}")
-    elif isinstance(expected, list):
-        assert isinstance(actual, list) and len(actual) == len(expected), (
-            f"{path}: {actual!r} != {expected!r}")
-        for i, v in enumerate(expected):
-            _assert_subset(v, actual[i], f"{path}[{i}]")
-    else:
-        assert actual == expected, f"{path}: {actual!r} != {expected!r}"
+    container = Container(
+        name="tpu", image="my-llm:latest",
+        command=["python", "train.py"], args=["--steps", "100"],
+        env=[EnvVar(name="LOG_LEVEL", value="debug")],
+        ports=[ContainerPort(name="grpc", container_port=2222)],
+        resources={constants.TPU_RESOURCE: 8.0},
+        extra={"volumeMounts": [{"name": "ckpt", "mountPath": "/ckpt"}]},
+    )
+    template = PodTemplateSpec(
+        metadata=ObjectMeta(name="pod-tmpl", namespace="train",
+                            uid="tmpl-uid", labels={"app": "llm"},
+                            annotations={"team": "research"}),
+        containers=[container],
+        restart_policy="OnFailure",
+        scheduler_name="volcano",
+        node_selector={"cloud.google.com/gke-tpu-topology": "2x4"},
+        extra={"volumes": [{"name": "ckpt", "emptyDir": {}}]},
+    )
+    worker = ReplicaSpec(
+        replicas=4, template=template,
+        restart_policy=RestartPolicy.EXIT_CODE,
+        tpu=TPUTopology(accelerator="v5litepod-8", topology="2x4",
+                        mesh={"dp": 2, "tp": 4},
+                        zero_shard_weight_update=True),
+        elastic=ElasticPolicy(min_replicas=2, max_replicas=4),
+    )
+    spec = TPUJobSpec(
+        replica_specs={ReplicaType.WORKER: worker},
+        run_policy=RunPolicy(
+            clean_pod_policy=CleanPodPolicy.ALL,
+            ttl_seconds_after_finished=600,
+            active_deadline_seconds=3600.0,
+            backoff_limit=3,
+            scheduling_policy=SchedulingPolicy(min_available=4,
+                                               queue="research"),
+        ),
+        success_policy=SuccessPolicy.ALL_WORKERS,
+        enable_dynamic_worker=True,
+    )
+    status = JobStatus(
+        conditions=[JobCondition(
+            type=JobConditionType.RUNNING, status=True, reason="r",
+            message="m", last_update_time=12.5,
+            last_transition_time=11.25)],
+        replica_statuses={"Worker": ReplicaStatus(active=3, succeeded=1,
+                                                  failed=2)},
+        start_time=10.0, completion_time=99.0, last_reconcile_time=98.5,
+        zero_sharding_plan={"axis": "dp", "numShards": 2,
+                            "replicaType": "Worker"},
+        elastic={"generation": 1, "groups": {}},
+    )
+    return TPUJob(
+        metadata=ObjectMeta(name="maximal", namespace="train",
+                            uid="job-uid", labels={"tier": "prod"},
+                            annotations={"note": "manifest-exhaustive"}),
+        spec=spec, status=status,
+    )
 
 
-@settings(max_examples=60, deadline=None)
-@given(_job_dict())
-def test_serialization_fixpoint_property(manifest):
-    """For ANY well-formed manifest: (a) every generated field survives
-    parse -> serialize with its value intact (catches consistent drops on
-    either side), and (b) to_dict(from_dict(.)) reaches a fixpoint in one
-    step (catches asymmetric rename/re-type mismatches) — together, the
-    bug classes that silently corrupt jobs passing through the apiserver
-    round-trip (get -> modify -> update)."""
-    d1 = job_to_dict(job_from_dict(manifest))
-    _assert_subset(manifest, d1)
-    d2 = job_to_dict(job_from_dict(d1))
+def _dataclass_instances(obj, seen=None):
+    """All dataclass instances reachable from obj, keyed by class name."""
+    import dataclasses
+
+    if seen is None:
+        seen = {}
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        seen.setdefault(type(obj).__name__, []).append(obj)
+        for f in dataclasses.fields(obj):
+            _dataclass_instances(getattr(obj, f.name), seen)
+    elif isinstance(obj, dict):
+        for v in obj.values():
+            _dataclass_instances(v, seen)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _dataclass_instances(v, seen)
+    return seen
+
+
+def test_manifest_exhaustive_round_trip():
+    """Driven by the extracted interface manifest: for every wire type
+    and every covered (to AND from, non-exempt) field, the maximal job
+    above carries a non-default value, and the whole job survives
+    dict -> object -> dict with exact equality.  A field the extractor
+    starts covering without a non-default value here fails loudly —
+    extend _maximal_job when the wire surface grows."""
+    import dataclasses
+    import pathlib
+
+    from tf_operator_tpu import analysis
+
+    package_dir = pathlib.Path(__file__).resolve().parent.parent \
+        / "tf_operator_tpu"
+    contract = analysis.package_contract(str(package_dir))
+    assert contract.wire_types, "extractor found no wire types"
+
+    job = _maximal_job()
+    instances = _dataclass_instances(job)
+    for wire_type in contract.wire_types.values():
+        assert wire_type.name in instances, (
+            f"manifest wire type {wire_type.name} unreachable from the "
+            f"maximal job — extend _maximal_job")
+        objs = instances[wire_type.name]
+        field_map = {f.name: f
+                     for f in dataclasses.fields(type(objs[0]))}
+        for wf in wire_type.fields.values():
+            if wf.exempt or not (wf.to and wf.frm):
+                continue
+            f = field_map[wf.name]
+            if f.default is not dataclasses.MISSING:
+                default = f.default
+            elif f.default_factory is not dataclasses.MISSING:
+                default = f.default_factory()
+            else:
+                continue  # required field: any value is non-default
+            assert any(getattr(o, wf.name) != default for o in objs), (
+                f"{wire_type.name}.{wf.name} is covered by the manifest "
+                f"but only carries its default in the maximal job")
+
+    d1 = job_to_dict(job)
+    d2 = job_to_dict(job_from_dict(json.loads(json.dumps(d1))))
     assert d1 == d2
+
+
+# ---------------------------------------------------------------------------
+# (the hypothesis property suite lives in test_serialization_properties.py
+#  so its importorskip cannot skip the deterministic tests above)
 
 
 def test_inline_run_policy_aliases_canonicalized():
@@ -333,34 +373,3 @@ def test_inline_run_policy_aliases_canonicalized():
     assert d_inline == d_nested
     rp = d_inline["spec"]["runPolicy"]
     assert rp["cleanPodPolicy"] == "All" and rp["backoffLimit"] == 7
-
-
-@settings(max_examples=60, deadline=None)
-@given(_job_dict())
-def test_defaults_idempotent_property(manifest):
-    """set_defaults runs on every watch event (controller.add_job and the
-    reconcile path both call it on fresh copies) — applying it twice must
-    change nothing beyond the first application, or repeated reconciles
-    would see phantom spec drift and re-queue forever."""
-    job = job_from_dict(manifest)
-    set_defaults(job)
-    once = job_to_dict(job)
-    set_defaults(job)
-    assert job_to_dict(job) == once
-
-
-@settings(max_examples=60, deadline=None)
-@given(_job_dict())
-def test_validation_total_property(manifest):
-    """validate() must either accept or raise ValidationError — any other
-    exception on an arbitrary well-formed manifest means a malformed user
-    job can crash the admission path instead of being rejected with a
-    Failed condition (controller.add_job only catches ValidationError)."""
-    from tf_operator_tpu.api.validation import ValidationError
-
-    job = job_from_dict(manifest)
-    set_defaults(job)
-    try:
-        validate(job)
-    except ValidationError:
-        pass

@@ -1,0 +1,142 @@
+"""Property-based serialization tests (hypothesis-dependent).
+
+Split out of tests/test_serialization.py: the module-level importorskip
+below skips THIS whole file when hypothesis is absent (it is not in the
+CI workflow's install list), without also skipping the deterministic
+serialization tests — in particular the manifest-driven exhaustive
+round trip, which must always run.
+"""
+import json
+
+import pytest
+
+from tf_operator_tpu.api.serialization import job_from_dict, job_to_dict
+from tf_operator_tpu.api.defaults import set_defaults
+from tf_operator_tpu.api.validation import validate
+
+hypothesis = pytest.importorskip(
+    "hypothesis")  # not in the CI workflow's install list
+from hypothesis import given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+
+_name = st.text(alphabet="abcdefghijklmnopqrstuvwxyz0123456789",
+                min_size=1, max_size=12)
+_rtypes = st.sampled_from(["Worker", "PS", "Chief", "Master", "Evaluator"])
+
+
+@st.composite
+def _replica_spec(draw):
+    spec = {
+        "replicas": draw(st.integers(min_value=0, max_value=8)),
+        "restartPolicy": draw(st.sampled_from(
+            ["Never", "Always", "OnFailure", "ExitCode"])),
+        "template": {"spec": {"containers": [{
+            "name": "tensorflow",
+            "image": draw(_name),
+            **({"command": draw(st.lists(_name, min_size=1, max_size=3))}
+               if draw(st.booleans()) else {}),
+            **({"env": [{"name": draw(_name).upper(),
+                         "value": draw(_name)}]}
+               if draw(st.booleans()) else {}),
+        }]}},
+    }
+    if draw(st.booleans()):
+        spec["tpu"] = {
+            "accelerator": draw(st.sampled_from(
+                ["v5litepod-8", "v5litepod-32", "v6e-64"])),
+            "topology": draw(st.sampled_from(["2x4", "4x8", "8x8"])),
+            **({"mesh": {"dp": 2, "tp": 4}} if draw(st.booleans()) else {}),
+        }
+    return spec
+
+
+@st.composite
+def _job_dict(draw):
+    rtypes = draw(st.lists(_rtypes, min_size=1, max_size=3, unique=True))
+    d = {
+        "apiVersion": "tpu-operator.dev/v1",
+        "kind": "TPUJob",
+        "metadata": {
+            "name": draw(_name),
+            "namespace": draw(_name),
+            **({"labels": draw(st.dictionaries(_name, _name, max_size=2))}
+               if draw(st.booleans()) else {}),
+        },
+        "spec": {
+            "replicaSpecs": {rt: draw(_replica_spec()) for rt in rtypes},
+            # canonical native schema nests run-policy fields under
+            # runPolicy; the reference's inline spellings are accepted on
+            # parse but canonicalized (see the alias-equivalence test)
+            **({"runPolicy": {
+                "backoffLimit": draw(st.integers(min_value=0, max_value=10)),
+                **({"cleanPodPolicy": draw(st.sampled_from(
+                    ["Running", "All", "None"]))}
+                   if draw(st.booleans()) else {}),
+            }} if draw(st.booleans()) else {}),
+        },
+    }
+    return d
+
+
+def _assert_subset(expected, actual, path="$"):
+    """Every field of `expected` must survive into `actual` with the same
+    value (the serializer may ADD defaulted fields, never drop or change
+    one)."""
+    if isinstance(expected, dict):
+        assert isinstance(actual, dict), f"{path}: {actual!r}"
+        for k, v in expected.items():
+            assert k in actual, f"{path}.{k} dropped"
+            _assert_subset(v, actual[k], f"{path}.{k}")
+    elif isinstance(expected, list):
+        assert isinstance(actual, list) and len(actual) == len(expected), (
+            f"{path}: {actual!r} != {expected!r}")
+        for i, v in enumerate(expected):
+            _assert_subset(v, actual[i], f"{path}[{i}]")
+    else:
+        assert actual == expected, f"{path}: {actual!r} != {expected!r}"
+
+
+@settings(max_examples=60, deadline=None)
+@given(_job_dict())
+def test_serialization_fixpoint_property(manifest):
+    """For ANY well-formed manifest: (a) every generated field survives
+    parse -> serialize with its value intact (catches consistent drops on
+    either side), and (b) to_dict(from_dict(.)) reaches a fixpoint in one
+    step (catches asymmetric rename/re-type mismatches) — together, the
+    bug classes that silently corrupt jobs passing through the apiserver
+    round-trip (get -> modify -> update)."""
+    d1 = job_to_dict(job_from_dict(manifest))
+    _assert_subset(manifest, d1)
+    d2 = job_to_dict(job_from_dict(d1))
+    assert d1 == d2
+
+
+@settings(max_examples=60, deadline=None)
+@given(_job_dict())
+def test_defaults_idempotent_property(manifest):
+    """set_defaults runs on every watch event (controller.add_job and the
+    reconcile path both call it on fresh copies) — applying it twice must
+    change nothing beyond the first application, or repeated reconciles
+    would see phantom spec drift and re-queue forever."""
+    job = job_from_dict(manifest)
+    set_defaults(job)
+    once = job_to_dict(job)
+    set_defaults(job)
+    assert job_to_dict(job) == once
+
+
+@settings(max_examples=60, deadline=None)
+@given(_job_dict())
+def test_validation_total_property(manifest):
+    """validate() must either accept or raise ValidationError — any other
+    exception on an arbitrary well-formed manifest means a malformed user
+    job can crash the admission path instead of being rejected with a
+    Failed condition (controller.add_job only catches ValidationError)."""
+    from tf_operator_tpu.api.validation import ValidationError
+
+    job = job_from_dict(manifest)
+    set_defaults(job)
+    try:
+        validate(job)
+    except ValidationError:
+        pass

@@ -54,6 +54,12 @@ FIXTURES = Path(__file__).resolve().parent / "lint_fixtures"
         ("bad_ownership_fence.py", "bad_ownership_fence.py",
          "ownership-fence", 13),
         ("bad_state_machine.py", "bad_state_machine.py", "state-machine", 9),
+        ("bad_wire_roundtrip.py", "bad_wire_roundtrip.py",
+         "wire-roundtrip", 11),
+        ("bad_knob_chain.py", "bad_knob_chain.py", "knob-chain", 9),
+        ("bad_metric_doc.py", "bad_metric_doc.py", "metric-doc", 14),
+        ("bad_condition_unset.py", "bad_condition_unset.py",
+         "state-machine", 10),
     ],
 )
 def test_rule_fires_exactly_once(fixture, rel_path, rule, line):
@@ -783,14 +789,69 @@ def test_state_machine_rejects_nonliteral_reasons():
         == ["state-machine"]
 
 
+def test_state_machines_cover_every_condition_type():
+    """Every JobConditionType member has a declared machine — the rule
+    verifies 'every declared condition is set somewhere' package-wide, so
+    an uncovered member would silently escape both checks."""
+    from tf_operator_tpu.api.types import JobConditionType
+
+    assert set(analysis.CONDITION_STATE_MACHINES) \
+        == {m.name for m in JobConditionType}
+    for name, machine in analysis.CONDITION_STATE_MACHINES.items():
+        assert set(machine) == {"set", "clear"}, name
+        assert machine["set"], f"{name} has no set-edge reasons"
+
+
+def test_contract_exempt_annotation_is_rule_scoped():
+    """`# contract: exempt(<rule>)` silences exactly the named rule at
+    the annotated site; a different rule name there changes nothing."""
+    lopsided = (
+        "from dataclasses import dataclass\n"
+        "@dataclass\n"
+        "class W:\n"
+        "    a: int = 0{ann}\n"
+        "def w_to_dict(w: W) -> dict:\n"
+        "    return {{'a': w.a}}\n"
+        "def w_from_dict(d: dict) -> W:\n"
+        "    return W()\n"
+    )
+    hit = analysis.check_source(lopsided.format(ann=""), "x.py")
+    assert [f.rule for f in hit] == ["wire-roundtrip"]
+    exempt = lopsided.format(ann="  # contract: exempt(wire-roundtrip)")
+    assert analysis.check_source(exempt, "x.py") == []
+    wrong = lopsided.format(ann="  # contract: exempt(knob-chain)")
+    assert [f.rule for f in analysis.check_source(wrong, "x.py")] \
+        == ["wire-roundtrip"]
+
+
+def test_knob_chain_requires_full_knob_name():
+    """A bare 'TPUJOB_' prefix string (env scrubbers iterate prefixes) and
+    prose mentioning a knob are not knob producers/consumers."""
+    scrubber = (
+        "def scrub(env):\n"
+        "    return {k: v for k, v in env.items()\n"
+        "            if not k.startswith('TPUJOB_')}\n"
+    )
+    assert analysis.check_source(scrubber, "x.py") == []
+    produced_only = (
+        "def inject(env):\n"
+        "    env['TPUJOB_ONLY_PRODUCED'] = '1'\n"
+    )
+    assert [f.rule for f in analysis.check_source(produced_only, "x.py")] \
+        == ["knob-chain"]
+
+
 def test_rule_doc_and_severity_metadata():
     """Every rule id resolves to a docs anchor; dynamic (race/explore-*)
     findings share the race-detector section.  Advisory rules are
     warnings, everything else an error."""
-    assert len(analysis.ALL_RULES) == 13  # 12 rules + parse-error
+    assert len(analysis.ALL_RULES) == 16  # 15 rules + parse-error
     for rule in (analysis.RULE_STATUSWRITER_BYPASS,
                  analysis.RULE_OWNERSHIP_FENCE,
-                 analysis.RULE_STATE_MACHINE):
+                 analysis.RULE_STATE_MACHINE,
+                 analysis.RULE_WIRE_ROUNDTRIP,
+                 analysis.RULE_KNOB_CHAIN,
+                 analysis.RULE_METRIC_DOC):
         assert rule in analysis.ALL_RULES
         assert analysis.rule_doc(rule) == f"docs/static-analysis.md#{rule}"
         assert analysis.RULE_SEVERITY.get(rule, "error") == "error"
@@ -935,6 +996,88 @@ def test_cli_rules_filter_and_exclude(tmp_path):
     )
     assert proc.returncode == 1
     assert "[parse-error]" in proc.stdout
+
+
+def test_cli_manifest_stdout_and_json(tmp_path):
+    """--manifest emits the canonical interface manifest: version 1,
+    stable schema id, and the four contract surfaces.  --json writes the
+    same document byte-for-byte regenerable (sorted keys)."""
+    import json
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.analysis",
+         str(PACKAGE_DIR), "--manifest"],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["version"] == 1
+    assert doc["schema"] == "tf-operator-tpu/interface-manifest"
+    for surface in ("wire", "knobs", "metrics", "conditions"):
+        assert doc[surface], f"empty {surface} surface"
+
+    out = tmp_path / "manifest.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.analysis",
+         str(PACKAGE_DIR), "--manifest", "--json", str(out)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert json.loads(out.read_text()) == doc
+
+
+def test_cli_manifest_diff_gate(tmp_path):
+    """--diff exits 0 on a matching committed snapshot, 1 with rendered
+    drift lines on a tampered one; --diff without --manifest is a usage
+    error (exit 2)."""
+    import json
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    committed = REPO / "docs" / "interface-manifest.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.analysis",
+         str(PACKAGE_DIR), "--manifest", "--diff", str(committed)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "interface manifest matches" in proc.stdout
+
+    doc = json.loads(committed.read_text())
+    doc["knobs"]["TPUJOB_NO_SUCH_KNOB"] = {
+        "constant": None, "consumers": [], "exempt": False,
+        "producers": []}
+    tampered = tmp_path / "stale-manifest.json"
+    tampered.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.analysis",
+         str(PACKAGE_DIR), "--manifest", "--diff", str(tampered)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 1
+    assert "manifest drift:" in proc.stdout
+    assert "TPUJOB_NO_SUCH_KNOB" in proc.stdout
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tf_operator_tpu.analysis",
+         str(PACKAGE_DIR), "--diff", str(committed)],
+        capture_output=True, text=True, env=env, cwd=str(tmp_path),
+    )
+    assert proc.returncode == 2
+    assert "--diff requires --manifest" in proc.stderr
+
+
+def test_committed_manifest_matches_regeneration():
+    """The PR-review contract: docs/interface-manifest.json is exactly
+    what --manifest regenerates from the package today."""
+    import json
+
+    contract = analysis.package_contract(str(PACKAGE_DIR))
+    committed = json.loads(
+        (REPO / "docs" / "interface-manifest.json").read_text())
+    assert analysis.contract.manifest_dict(contract) == committed
 
 
 # ---------------------------------------------------------------------------

@@ -47,11 +47,34 @@ plane rather than generic concurrency hygiene:
                        `owns()` / `owns_key()` — an unfenced path processes
                        keys another replica owns.
   state-machine        condition transitions named in
-                       `CONDITION_STATE_MACHINES` (first machine: the
-                       elastic Resizing→RunningResized arc) must use a
-                       declared literal reason; an undeclared or
-                       non-literal reason is an edge the machine does not
-                       have.
+                       `CONDITION_STATE_MACHINES` (one machine per
+                       JobConditionType member — all seven are declared)
+                       must use a declared reason (literal, module
+                       constant, or a local assigned only literals); an
+                       undeclared or unresolvable reason is an edge the
+                       machine does not have.  The contract extractor
+                       additionally reports a declared condition type that
+                       is never set at any write site.
+
+Three contract-drift rules are fed by the interface-manifest extractor
+(`analysis/contract.py`, docs/static-analysis.md#contract-drift-rules),
+which walks the package once and reconstructs the operator's contract
+surface — wire dataclasses, TPUJOB_* env knobs, tpujob_* metrics,
+condition write sites — into `interface-manifest.json` (CI diff-gates it
+against the committed docs/interface-manifest.json):
+
+  wire-roundtrip  a wire dataclass field serialized by `*_to_dict` but
+                  never restored by `*_from_dict` (or vice versa, or
+                  neither) — the round-trip drift class behind the old
+                  `spec_entries` leak.
+  knob-chain      a TPUJOB_* env knob produced (gen_tpu_env) with no
+                  consumer, consumed but never produced, or declared dead.
+  metric-doc      an emitted tpujob_* metric missing from
+                  docs/monitoring.md, or a documented one never emitted.
+
+Contract sites are exempted with `# contract: exempt(<rule>)` next to a
+why-comment (intentionally one-directional fields, user-set env
+overrides); `# lint: allow(<rule>)` also works at the reporting site.
 
 Three further rules are interprocedural and package-wide, built from a
 whole-program call graph + lock-acquisition graph (`analysis/lockgraph.py`):
@@ -85,7 +108,7 @@ import re
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
-from . import lockgraph
+from . import contract, lockgraph
 from .lockgraph import (
     RULE_ATOMICITY,
     RULE_GUARDED_INTERPROC,
@@ -101,6 +124,9 @@ RULE_SLEEP_POLL = "sleep-poll"
 RULE_STATUSWRITER_BYPASS = "statuswriter-bypass"
 RULE_OWNERSHIP_FENCE = "ownership-fence"
 RULE_STATE_MACHINE = "state-machine"
+RULE_WIRE_ROUNDTRIP = contract.RULE_WIRE
+RULE_KNOB_CHAIN = contract.RULE_KNOB
+RULE_METRIC_DOC = contract.RULE_METRIC
 # not a style rule: an unparseable file cannot be checked, which must
 # surface as a finding (exit 1), never as a traceback
 RULE_PARSE_ERROR = "parse-error"
@@ -122,7 +148,21 @@ ALL_RULES = (
     RULE_LOCK_ORDER,
     RULE_GUARDED_INTERPROC,
     RULE_ATOMICITY,
+    RULE_WIRE_ROUNDTRIP,
+    RULE_KNOB_CHAIN,
+    RULE_METRIC_DOC,
     RULE_PARSE_ERROR,
+)
+
+# Rules whose findings come out of the contract extractor's whole-tree
+# pass (analysis/contract.py) rather than a per-file visitor.  The
+# state-machine rule is both: per-file for write-site edges, contract-fed
+# for never-set condition types.
+CONTRACT_RULES = (
+    RULE_WIRE_ROUNDTRIP,
+    RULE_KNOB_CHAIN,
+    RULE_METRIC_DOC,
+    RULE_STATE_MACHINE,
 )
 
 # Schema version of the --json findings document (docs/static-analysis.md).
@@ -150,10 +190,38 @@ def rule_doc(rule: str) -> str:
 
 
 # Declared condition state machines for the `state-machine` rule: condition
-# type name -> the literal reasons allowed to set it true / flip it false.
-# Transitions on other condition types are unconstrained until a machine is
-# declared for them.
+# type name -> the reasons allowed to set it true / flip it false.  Every
+# JobConditionType member carries a machine (tests pin the coverage);
+# SUCCEEDED and FAILED are terminal — an empty clear set means any
+# clear-transition out of them is an undeclared edge.  Transitions on
+# condition types outside this table (e.g. fixture-local enums) stay
+# unconstrained.
 CONDITION_STATE_MACHINES = {
+    "CREATED": {
+        "set": {"TPUJobCreated"},
+        "clear": set(),
+    },
+    "RUNNING": {
+        "set": {"TPUJobRunning"},
+        "clear": set(),
+    },
+    "RESTARTING": {
+        "set": {"JobRestarting"},
+        "clear": set(),
+    },
+    "SUCCEEDED": {  # terminal
+        "set": {"TPUJobSucceeded"},
+        "clear": set(),
+    },
+    "FAILED": {  # terminal
+        "set": {"TPUJobFailed", "FailedValidation",
+                "BackoffLimitExceeded", "DeadlineExceeded"},
+        "clear": set(),
+    },
+    "STUCK": {
+        "set": {"JobStuck"},
+        "clear": {"SyncRecovered"},
+    },
     "RESIZING": {
         "set": {"JobResizing"},
         "clear": {"RunningResized"},
@@ -280,6 +348,19 @@ class _FileChecker:
             if isinstance(node, ast.ClassDef) and node.end_lineno is not None:
                 for line in range(node.lineno, node.end_lineno + 1):
                     self.class_at_line[line] = node.name
+        # state-machine reason resolution: module-level string constants
+        # (JOB_STUCK_REASON et al.) plus the innermost function covering a
+        # line, so contract.reason_candidates can resolve Name reasons
+        # assigned only literals (same parents-before-children walk order
+        # as class_at_line: the last writer is the innermost function).
+        self.module_consts: Dict[str, str] = contract.module_string_consts(
+            self.tree)
+        self.func_at_line: Dict[int, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and node.end_lineno is not None):
+                for line in range(node.lineno, node.end_lineno + 1):
+                    self.func_at_line[line] = node
         # ownership-fence arms only in federated modules: anything that
         # talks about the shard-lease manager is expected to fence its
         # queue traffic; modules that predate federation stay untouched.
@@ -569,9 +650,11 @@ class _FileChecker:
 
     def _check_state_machine(self, node: ast.Call) -> None:
         """Condition transitions on a declared machine must use a declared
-        literal reason: the edge set in CONDITION_STATE_MACHINES is the
-        spec, and a novel (or non-literal) reason is an edge the machine
-        does not have."""
+        reason: the edge set in CONDITION_STATE_MACHINES is the spec, and
+        a novel (or unresolvable) reason is an edge the machine does not
+        have.  Reasons resolve through contract.reason_candidates —
+        literals, module string constants, and locals assigned only
+        literals all check; anything else is uncheckable and reports."""
         func = node.func
         name = (func.attr if isinstance(func, ast.Attribute)
                 else func.id if isinstance(func, ast.Name) else None)
@@ -586,13 +669,15 @@ class _FileChecker:
             return
         allowed = machine[verb]
         reason = self._call_arg(node, 2, "reason")
-        if (isinstance(reason, ast.Constant)
-                and isinstance(reason.value, str)):
-            if reason.value in allowed:
-                return
-            detail = f"undeclared reason {reason.value!r}"
-        else:
+        candidates = contract.reason_candidates(
+            reason, self.module_consts, self.func_at_line.get(node.lineno))
+        if candidates is None:
             detail = "a non-literal reason (the edge set is uncheckable)"
+        else:
+            bad = sorted(set(candidates) - allowed)
+            if not bad:
+                return
+            detail = f"undeclared reason {bad[0]!r}"
         self._report(
             RULE_STATE_MACHINE, node,
             f"{key} {verb} transition with {detail}; declared edges for "
@@ -1001,15 +1086,20 @@ def _project_findings(checkers: List[_FileChecker]) -> List[Finding]:
 
 def _check_many(files: Sequence[Tuple[str, str]],
                 test_scope: Optional[bool] = None,
-                rules: Optional[Iterable[str]] = None) -> List[Finding]:
+                rules: Optional[Iterable[str]] = None,
+                contract_doc: Optional[Tuple[str, str]] = None) -> List[Finding]:
     """Per-file rules + the interprocedural pass over `(rel_path, source)`
     pairs; unparseable files surface as parse-error findings and drop out
     of the project model.  When a `rules` subset is given that names no
     interprocedural rule, the whole-program pass is skipped entirely —
     the CI tests-tree sleep-poll pass must not pay for a call-graph
-    fixpoint whose findings it would discard."""
+    fixpoint whose findings it would discard.  The contract-drift pass
+    (CONTRACT_RULES, analysis/contract.py) is gated the same way;
+    `contract_doc` is the optional (display_path, text) of
+    docs/monitoring.md for the metric-doc rule."""
     findings: List[Finding] = []
     checkers: List[_FileChecker] = []
+    contract_files: List[Tuple[str, str, ast.AST]] = []
     for rel_path, source in files:
         try:
             checker = _FileChecker(source, rel_path, test_scope=test_scope)
@@ -1021,9 +1111,21 @@ def _check_many(files: Sequence[Tuple[str, str]],
             continue
         findings.extend(checker.run())
         checkers.append(checker)
+        contract_files.append((checker.rel_path, source, checker.tree))
     wanted = None if rules is None else set(rules)
     if wanted is None or wanted & set(lockgraph.LOCKGRAPH_RULES):
         findings.extend(_project_findings(checkers))
+    if wanted is None or wanted & set(CONTRACT_RULES):
+        by_path = {c.rel_path: c for c in checkers}
+        built = contract.build_contract(contract_files, doc=contract_doc)
+        for rule, path, line, message in contract.contract_findings(built):
+            checker = by_path.get(path)
+            # `# lint: allow(...)` works on contract findings too; the
+            # extractor's own `# contract: exempt(...)` was applied inside
+            # contract_findings.  Doc-side findings have no checker.
+            if checker is not None and _suppressed(checker, line, rule):
+                continue
+            findings.append(Finding(rule, path, line, message))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
@@ -1046,15 +1148,10 @@ def check_file(path: str, rel_path: Optional[str] = None,
                         test_scope=test_scope)
 
 
-def check_package(root: str,
-                  exclude_dirs: Iterable[str] = (),
-                  rules: Optional[Iterable[str]] = None) -> List[Finding]:
-    """Lint every .py under the package directory `root` (per-file rules
-    file by file, interprocedural rules over the whole tree).  Directory
-    names in `exclude_dirs` are pruned (e.g. known-bad fixture dirs);
-    `rules` (when given) lets _check_many skip the whole-program pass if
-    no interprocedural rule is requested — the caller still post-filters
-    the per-file findings."""
+def _package_files(root: str,
+                   exclude_dirs: Iterable[str] = ()) -> List[Tuple[str, str]]:
+    """Sorted (rel_path, source) pairs for every .py under `root`, with
+    `exclude_dirs` (and __pycache__) pruned."""
     skip = {"__pycache__", *exclude_dirs}
     files: List[Tuple[str, str]] = []
     for dirpath, dirnames, filenames in os.walk(root):
@@ -1064,12 +1161,48 @@ def check_package(root: str,
                 continue
             path = os.path.join(dirpath, filename)
             with open(path, encoding="utf-8") as f:
-                files.append((os.path.relpath(path, root), f.read()))
+                files.append((os.path.relpath(path, root)
+                              .replace(os.sep, "/"), f.read()))
+    return files
+
+
+def _monitoring_doc(root: str) -> Optional[Tuple[str, str]]:
+    """(display_path, text) of docs/monitoring.md next to the package
+    root, or None — the metric-doc rule's reference surface."""
+    doc_path = os.path.join(os.path.dirname(os.path.abspath(root)),
+                            "docs", "monitoring.md")
+    if not os.path.exists(doc_path):
+        return None
+    with open(doc_path, encoding="utf-8") as f:
+        return "../docs/monitoring.md", f.read()
+
+
+def check_package(root: str,
+                  exclude_dirs: Iterable[str] = (),
+                  rules: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Lint every .py under the package directory `root` (per-file rules
+    file by file, interprocedural + contract rules over the whole tree).
+    Directory names in `exclude_dirs` are pruned (e.g. known-bad fixture
+    dirs); `rules` (when given) lets _check_many skip the whole-program
+    passes if no interprocedural/contract rule is requested — the caller
+    still post-filters the per-file findings."""
+    files = _package_files(root, exclude_dirs)
     # when the lint root IS a tests tree, rel paths carry no `tests`
-    # segment — force the scope so sleep-poll still arms
+    # segment — force the scope so sleep-poll still arms; the monitoring
+    # doc belongs to the package surface only, never to a tests tree
     root_is_tests = os.path.basename(os.path.abspath(root)) == "tests"
     return _check_many(files, test_scope=True if root_is_tests else None,
-                       rules=rules)
+                       rules=rules,
+                       contract_doc=None if root_is_tests
+                       else _monitoring_doc(root))
+
+
+def package_contract(root: str,
+                     exclude_dirs: Iterable[str] = ()) -> contract.Contract:
+    """The extracted contract surface of a package directory — what
+    `--manifest` serializes and tests introspect (analysis/contract.py)."""
+    return contract.build_contract(_package_files(root, exclude_dirs),
+                                   doc=_monitoring_doc(root))
 
 
 def write_findings_json(path: str, findings: List[Finding],
@@ -1152,7 +1285,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "(e.g. lint_fixtures)")
     parser.add_argument("--json", default=None, metavar="PATH",
                         help="also write machine-readable findings to PATH "
-                             "(schema in docs/static-analysis.md)")
+                             "(schema in docs/static-analysis.md); with "
+                             "--manifest, write the manifest there instead")
+    parser.add_argument("--manifest", action="store_true",
+                        help="emit the interface manifest (contract "
+                             "surface, docs/static-analysis.md"
+                             "#interface-manifest) instead of lint "
+                             "findings: print it (or --json PATH it)")
+    parser.add_argument("--diff", default=None, metavar="PATH",
+                        help="with --manifest: compare the regenerated "
+                             "manifest against the committed snapshot at "
+                             "PATH and exit 1 on drift")
     parser.add_argument("--race", default=None, metavar="SCENARIO",
                         help="instead of the static lint, run the "
                              "race-checked interleaving soak over one "
@@ -1164,6 +1307,40 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--seed", type=int, default=0,
                         help="base seed for --race schedules (default: 0)")
     args = parser.parse_args(argv)
+    if args.diff is not None and not args.manifest:
+        parser.error("--diff requires --manifest")
+
+    if args.manifest:
+        root, _prefix = resolve_package_dir(args.package)
+        exclude = [d for d in (args.exclude or "").split(",") if d]
+        doc = contract.manifest_dict(package_contract(root,
+                                                      exclude_dirs=exclude))
+        text = json.dumps(doc, indent=2, sort_keys=True) + "\n"
+        if args.json is not None:
+            with open(args.json, "w", encoding="utf-8") as f:
+                f.write(text)
+        else:
+            print(text, end="")
+        if args.diff is not None:
+            try:
+                with open(args.diff, encoding="utf-8") as f:
+                    committed = json.load(f)
+            except (OSError, ValueError) as err:
+                print(f"cannot read committed manifest {args.diff}: {err}")
+                return 1
+            drift = contract.diff_summary(committed, doc)
+            if drift:
+                for line in drift[:40]:
+                    print(f"manifest drift: {line}")
+                if len(drift) > 40:
+                    print(f"... and {len(drift) - 40} more difference(s)")
+                print(f"interface manifest drifted from {args.diff}; if "
+                      f"the contract change is intentional, regenerate "
+                      f"with `python -m tf_operator_tpu.analysis "
+                      f"--manifest --json {args.diff}` and commit it")
+                return 1
+            print(f"interface manifest matches {args.diff}")
+        return 0
 
     if args.race is not None:
         from . import scenarios

@@ -1,0 +1,880 @@
+"""Contract-surface extraction: the interface manifest behind the four
+contract-drift rules (docs/static-analysis.md#interface-manifest).
+
+The operator's real API is not a function signature — it is *contract
+wiring*: dataclass fields that must survive a dict round-trip
+(api/serialization.py), TPUJOB_* env knobs that must flow producer →
+consumer (controller/topology.py → workloads/runner.py), tpujob_* metrics
+that must match docs/monitoring.md, and JobConditionType members that must
+be reachable with declared reasons.  This module extracts that surface from
+the AST alone (stdlib only, no imports of the checked code) into a
+canonical, schema-versioned manifest dict, and derives conformance findings
+from it:
+
+    wire-roundtrip   field serialized in only one direction (or neither)
+    knob-chain       knob produced with no consumer / consumed but never
+                     produced / declared but dead
+    metric-doc       emitted metric undocumented, or documented metric
+                     never emitted
+    state-machine    declared condition type never set at any write site
+                     (the per-write-site edge check lives in __init__)
+
+Sites are exempted with a `# contract: exempt(<rule>)` annotation on the
+flagged line (or the first line of its statement), always next to a comment
+saying *why* — the analogue of `# lint: allow(...)` for contract surface
+that is intentionally one-directional or externally owned.
+
+`__init__` imports this module (never the reverse); rule-name strings are
+therefore duplicated here rather than imported.
+"""
+from __future__ import annotations
+
+import ast
+import posixpath
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+MANIFEST_VERSION = 1
+MANIFEST_SCHEMA = "tf-operator-tpu/interface-manifest"
+
+KNOB_PREFIX = "TPUJOB_"
+METRIC_PREFIX = "tpujob_"
+CONDITION_ENUM = "JobConditionType"
+
+RULE_WIRE = "wire-roundtrip"
+RULE_KNOB = "knob-chain"
+RULE_METRIC = "metric-doc"
+RULE_STATE = "state-machine"
+
+# condition-write entry points (runtime/conditions.py) and their verb
+CONDITION_CALLS = {
+    "update_job_conditions": "set",
+    "set_operational_condition": "set",
+    "clear_condition": "clear",
+}
+
+# a knob is the *full* TPUJOB_<NAME> string — the bare prefix (e.g.
+# `key.startswith("TPUJOB_")`) and prose strings embedding a knob name
+# ("TPUJOB_X entries may be stale ...") must not register
+_KNOB_NAME_RE = re.compile(r"^TPUJOB_[A-Z0-9_]+$")
+_EXEMPT_RE = re.compile(r"#\s*contract:\s*exempt\(([a-z-]+)\)")
+_METRIC_DOC_RE = re.compile(r"\btpujob_[a-z0-9_]+")
+
+Site = Tuple[str, int]  # (rel_path, line)
+
+
+# ---------------------------------------------------------------------------
+# per-file parse state
+
+
+class _FileInfo:
+    """One parsed source file: tree + exemption annotations.
+
+    Mirrors the statement-header logic of the lint suppressions: an
+    annotation on the first line of a multi-line statement covers every
+    line of that statement.
+    """
+
+    def __init__(self, rel_path: str, source: str, tree=None):
+        self.rel_path = rel_path
+        self.source = source
+        self.error: Optional[SyntaxError] = None
+        if tree is None:
+            try:
+                tree = ast.parse(source)
+            except SyntaxError as err:
+                self.error = err
+                tree = None
+        self.tree = tree
+        self.exempt: Dict[int, set] = {}
+        for lineno, line in enumerate(source.splitlines(), 1):
+            for m in _EXEMPT_RE.finditer(line):
+                self.exempt.setdefault(lineno, set()).add(m.group(1))
+        self.stmt_header: Dict[int, int] = {}
+        if self.tree is not None:
+            for node in ast.walk(self.tree):
+                if isinstance(node, ast.stmt) and getattr(node, "end_lineno", None):
+                    for line_no in range(node.lineno, node.end_lineno + 1):
+                        prev = self.stmt_header.get(line_no)
+                        if prev is None or node.lineno > prev:
+                            self.stmt_header[line_no] = node.lineno
+
+    def is_exempt(self, line: int, rule: str) -> bool:
+        if rule in self.exempt.get(line, ()):
+            return True
+        header = self.stmt_header.get(line)
+        return header is not None and rule in self.exempt.get(header, ())
+
+
+# ---------------------------------------------------------------------------
+# data model
+
+
+@dataclass
+class WireField:
+    name: str
+    line: int
+    to: bool = False
+    frm: bool = False
+    exempt: bool = False
+
+
+@dataclass
+class WireType:
+    name: str
+    path: str
+    line: int
+    fields: Dict[str, WireField] = field(default_factory=dict)
+
+
+@dataclass
+class Knob:
+    name: str
+    constant: Optional[str] = None
+    const_site: Optional[Site] = None
+    producers: List[Site] = field(default_factory=list)
+    consumers: List[Site] = field(default_factory=list)
+    exempt: bool = False
+
+
+@dataclass
+class Metric:
+    name: str
+    kind: str
+    labels: List[str]
+    path: str
+    line: int
+    exempt: bool = False
+
+
+@dataclass
+class Condition:
+    name: str
+    path: str
+    line: int
+    set_reasons: set = field(default_factory=set)
+    clear_reasons: set = field(default_factory=set)
+    set_sites: int = 0
+    exempt: bool = False
+
+
+@dataclass
+class Contract:
+    serializer_modules: List[str]
+    wire_types: Dict[str, WireType]
+    knobs: Dict[str, Knob]
+    metrics: Dict[str, Metric]
+    conditions: Dict[str, Condition]
+    doc_path: Optional[str] = None
+    documented: Dict[str, int] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+
+
+def _type_name(node) -> Optional[str]:
+    """The bare type name a Name/Attribute/str-Constant node refers to."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value  # forward reference
+    return None
+
+
+def _ann_info(node) -> Tuple[Optional[str], Optional[str], Optional[str]]:
+    """(direct, element, mapping-value) type names of an annotation.
+
+    Optional[X] is transparent; List/Sequence/Set/Tuple yield their element
+    type; Dict/Mapping yield their value type (the key side of the wire
+    dicts is always a plain enum/str).
+    """
+    name = _type_name(node)
+    if name is not None:
+        return name, None, None
+    if isinstance(node, ast.Subscript):
+        base = _type_name(node.value)
+        if base == "Optional":
+            return _ann_info(node.slice)
+        if base in ("List", "Sequence", "Set", "FrozenSet", "Tuple",
+                    "list", "tuple", "set", "frozenset"):
+            elts = (node.slice.elts
+                    if isinstance(node.slice, ast.Tuple) else [node.slice])
+            return None, _type_name(elts[0]) if elts else None, None
+        if base in ("Dict", "Mapping", "MutableMapping", "dict"):
+            if isinstance(node.slice, ast.Tuple) and len(node.slice.elts) == 2:
+                return None, None, _type_name(node.slice.elts[1])
+    return None, None, None
+
+
+def _ann_names(node) -> List[str]:
+    return [n for n in _ann_info(node) if n is not None]
+
+
+def _param_names(fn) -> set:
+    args = fn.args
+    names = {a.arg for a in args.posonlyargs + args.args + args.kwonlyargs}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    return names
+
+
+def reason_candidates(node, module_consts: Dict[str, str],
+                      enclosing_fn=None) -> Optional[List[str]]:
+    """The reason strings a condition-write argument can evaluate to, or
+    None when the edge set is uncheckable (parameter, attribute, call, ...).
+
+    Resolves: string literals; module-level string constants; local
+    variables whose every assignment in the enclosing function is a string
+    literal (empty-string assignments are dropped — the ``reason = ""``
+    then ``if reason:`` idiom means empty never reaches the write)."""
+    if isinstance(node, ast.Constant):
+        return [node.value] if isinstance(node.value, str) else None
+    if isinstance(node, ast.Name):
+        if node.id in module_consts:
+            return [module_consts[node.id]]
+        if enclosing_fn is not None and node.id not in _param_names(enclosing_fn):
+            values: List[str] = []
+            for sub in ast.walk(enclosing_fn):
+                if isinstance(sub, ast.Assign):
+                    targets = sub.targets
+                elif isinstance(sub, (ast.AnnAssign, ast.AugAssign, ast.NamedExpr)):
+                    targets = [sub.target]
+                elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                    targets = [sub.target]
+                else:
+                    continue
+                plain_hit = any(isinstance(t, ast.Name) and t.id == node.id
+                                for t in targets)
+                nested_hit = any(
+                    isinstance(n, ast.Name) and n.id == node.id
+                    for t in targets for n in ast.walk(t))
+                if not nested_hit:
+                    continue
+                if not plain_hit:  # tuple unpacking etc. hides the value
+                    return None
+                if isinstance(sub, ast.AnnAssign) and sub.value is None:
+                    continue  # bare annotation binds nothing
+                value = getattr(sub, "value", None)
+                if (isinstance(sub, (ast.Assign, ast.AnnAssign, ast.NamedExpr))
+                        and isinstance(value, ast.Constant)
+                        and isinstance(value.value, str)):
+                    values.append(value.value)
+                else:
+                    return None  # reassigned from something non-literal
+            values = sorted({v for v in values if v})
+            if values:
+                return values
+    return None
+
+
+def module_string_consts(tree) -> Dict[str, str]:
+    """Module-level NAME = "literal" assignments (reason/knob constants)."""
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            target, value = stmt.targets[0], stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            target, value = stmt.target, stmt.value
+        else:
+            continue
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            out[target.id] = value.value
+    return out
+
+
+def _walk_with_fn(tree):
+    """Yield (node, innermost enclosing FunctionDef or None) pairs."""
+
+    def visit(node, fn):
+        for child in ast.iter_child_nodes(node):
+            child_fn = (child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef)) else fn)
+            yield child, child_fn
+            yield from visit(child, child_fn)
+
+    yield from visit(tree, None)
+
+
+def _call_arg(node: ast.Call, index: int, keyword: str):
+    for kw in node.keywords:
+        if kw.arg == keyword:
+            return kw.value
+    if len(node.args) > index:
+        return node.args[index]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# (a) wire types: declared fields vs to_dict/from_dict coverage
+
+
+def _is_dataclass(cls: ast.ClassDef) -> bool:
+    for deco in cls.decorator_list:
+        target = deco.func if isinstance(deco, ast.Call) else deco
+        if _type_name(target) == "dataclass":
+            return True
+    return False
+
+
+@dataclass
+class _FieldDecl:
+    name: str
+    line: int
+    ann: object
+
+
+def _class_fields(cls: ast.ClassDef) -> List[_FieldDecl]:
+    out = []
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            ann = stmt.annotation
+            base = ann.value if isinstance(ann, ast.Subscript) else ann
+            if _type_name(base) == "ClassVar":
+                continue
+            out.append(_FieldDecl(stmt.target.id, stmt.lineno, ann))
+    return out
+
+
+def _extract_wire(infos: Sequence[_FileInfo]):
+    # every @dataclass in the scanned set, preferring definitions that live
+    # next to a serializer module when a name is defined more than once
+    defs: Dict[str, List[Tuple[_FileInfo, ast.ClassDef]]] = {}
+    for info in infos:
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.ClassDef) and _is_dataclass(node):
+                defs.setdefault(node.name, []).append((info, node))
+
+    serializers = []
+    for info in infos:
+        to_funcs = [n for n in info.tree.body
+                    if isinstance(n, ast.FunctionDef)
+                    and n.name.endswith("_to_dict")]
+        from_funcs = [n for n in info.tree.body
+                      if isinstance(n, ast.FunctionDef)
+                      and n.name.endswith("_from_dict")]
+        if to_funcs and from_funcs:
+            serializers.append((info, to_funcs, from_funcs))
+
+    ser_dirs = {posixpath.dirname(info.rel_path.replace("\\", "/"))
+                for info, _t, _f in serializers}
+
+    def pick(candidates):
+        def key(item):
+            info, _cls = item
+            d = posixpath.dirname(info.rel_path.replace("\\", "/"))
+            return (0 if d in ser_dirs else 1, info.rel_path)
+        return min(candidates, key=key)
+
+    table: Dict[str, Tuple[_FileInfo, ast.ClassDef, List[_FieldDecl]]] = {}
+    for name, candidates in defs.items():
+        info, cls = pick(candidates)
+        table[name] = (info, cls, _class_fields(cls))
+
+    def fields_of(cls_name: str, attr: str):
+        entry = table.get(cls_name)
+        if entry is None:
+            return None
+        for f in entry[2]:
+            if f.name == attr:
+                return _ann_info(f.ann)
+        return None
+
+    # seed the closure from serializer signatures and constructor calls
+    seeds: set = set()
+    for info, to_funcs, from_funcs in serializers:
+        for fn in to_funcs:
+            for arg in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+                if arg.annotation is not None:
+                    for nm in _ann_names(arg.annotation):
+                        if nm in table:
+                            seeds.add(nm)
+        for fn in from_funcs:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    nm = _type_name(node.func)
+                    if nm in table:
+                        seeds.add(nm)
+
+    closure: set = set()
+    queue = sorted(seeds)
+    while queue:
+        nm = queue.pop()
+        if nm in closure:
+            continue
+        closure.add(nm)
+        for f in table[nm][2]:
+            for ref in _ann_names(f.ann):
+                if ref in table and ref not in closure:
+                    queue.append(ref)
+
+    wire_types: Dict[str, WireType] = {}
+    for nm in sorted(closure):
+        info, cls, fields = table[nm]
+        wt = WireType(nm, info.rel_path, cls.lineno)
+        for f in fields:
+            wf = WireField(f.name, f.line)
+            wf.exempt = info.is_exempt(f.line, RULE_WIRE)
+            wt.fields[f.name] = wf
+        wire_types[nm] = wt
+
+    def infer_expr(node, env):
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            base = infer_expr(node.value, env)
+            if base is not None:
+                got = fields_of(base, node.attr)
+                if got is not None:
+                    return got[0]
+            return None
+        if isinstance(node, ast.Call):
+            nm = _type_name(node.func)
+            if nm in closure:
+                return nm
+        return None
+
+    def bind_iter(target, iter_node, env):
+        # for x in obj.field / for k, v in obj.field.items()
+        if (isinstance(iter_node, ast.Call)
+                and isinstance(iter_node.func, ast.Attribute)
+                and iter_node.func.attr == "items" and not iter_node.args):
+            inner = iter_node.func.value
+            if isinstance(inner, ast.Attribute):
+                base = infer_expr(inner.value, env)
+                if base is not None:
+                    got = fields_of(base, inner.attr)
+                    if (got is not None and got[2] is not None
+                            and isinstance(target, ast.Tuple)
+                            and len(target.elts) == 2
+                            and isinstance(target.elts[1], ast.Name)):
+                        env[target.elts[1].id] = got[2]
+            return
+        if isinstance(iter_node, ast.Attribute):
+            base = infer_expr(iter_node.value, env)
+            if base is not None:
+                got = fields_of(base, iter_node.attr)
+                if (got is not None and got[1] is not None
+                        and isinstance(target, ast.Name)):
+                    env[target.id] = got[1]
+
+    def typed_env(fn):
+        env: Dict[str, str] = {}
+        for arg in fn.args.posonlyargs + fn.args.args + fn.args.kwonlyargs:
+            if arg.annotation is not None:
+                for nm in _ann_names(arg.annotation):
+                    if nm in closure:
+                        env[arg.arg] = nm
+                        break
+        for _ in range(3):  # fixpoint over local aliases / nested loops
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    t = infer_expr(node.value, env)
+                    if t in closure:
+                        env[node.targets[0].id] = t
+                elif (isinstance(node, ast.AnnAssign)
+                        and isinstance(node.target, ast.Name)):
+                    for nm in _ann_names(node.annotation):
+                        if nm in closure:
+                            env[node.target.id] = nm
+                            break
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    bind_iter(node.target, node.iter, env)
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.GeneratorExp, ast.DictComp)):
+                    for gen in node.generators:
+                        bind_iter(gen.target, gen.iter, env)
+        return env
+
+    for info, to_funcs, from_funcs in serializers:
+        for fn in to_funcs:
+            env = typed_env(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Attribute):
+                    base = infer_expr(node.value, env)
+                    if base in wire_types and node.attr in wire_types[base].fields:
+                        wire_types[base].fields[node.attr].to = True
+        for fn in from_funcs:
+            env = typed_env(fn)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    nm = _type_name(node.func)
+                    if nm not in wire_types:
+                        continue
+                    order = [f.name for f in table[nm][2]]
+                    for i, _arg in enumerate(node.args):
+                        if i < len(order):
+                            wire_types[nm].fields[order[i]].frm = True
+                    for kw in node.keywords:
+                        if kw.arg in wire_types[nm].fields:
+                            wire_types[nm].fields[kw.arg].frm = True
+                elif (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Store)):
+                    base = infer_expr(node.value, env)
+                    if base in wire_types and node.attr in wire_types[base].fields:
+                        wire_types[base].fields[node.attr].frm = True
+
+    modules = sorted(info.rel_path for info, _t, _f in serializers)
+    return modules, wire_types
+
+
+# ---------------------------------------------------------------------------
+# (b) TPUJOB_* env knobs: producers vs consumers
+
+
+def _extract_knobs(infos: Sequence[_FileInfo]) -> Dict[str, Knob]:
+    knobs: Dict[str, Knob] = {}
+    by_path = {info.rel_path: info for info in infos}
+    const_table: Dict[str, str] = {}
+
+    def knob(name: str) -> Knob:
+        return knobs.setdefault(name, Knob(name))
+
+    for info in infos:
+        for const_name, value in module_string_consts(info.tree).items():
+            if _KNOB_NAME_RE.match(value):
+                const_table[const_name] = value
+
+    # record declaration sites (first per knob, in path order)
+    for info in sorted(infos, key=lambda i: i.rel_path):
+        for stmt in info.tree.body:
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)):
+                target, value = stmt.targets[0], stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                target, value = stmt.target, stmt.value
+            else:
+                continue
+            if (isinstance(value, ast.Constant) and isinstance(value.value, str)
+                    and _KNOB_NAME_RE.match(value.value)):
+                k = knob(value.value)
+                if k.const_site is None:
+                    k.constant = target.id
+                    k.const_site = (info.rel_path, stmt.lineno)
+
+    def knob_of(node) -> Optional[str]:
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and _KNOB_NAME_RE.match(node.value)):
+            return node.value
+        if isinstance(node, ast.Name):
+            return const_table.get(node.id)
+        if isinstance(node, ast.Attribute):
+            return const_table.get(node.attr)
+        return None
+
+    for info in infos:
+        path = info.rel_path
+        for node in ast.walk(info.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        name = knob_of(t.slice)
+                        if name:
+                            knob(name).producers.append((path, t.lineno))
+            elif isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if key is not None:
+                        name = knob_of(key)
+                        if name:
+                            knob(name).producers.append((path, key.lineno))
+            elif isinstance(node, ast.Call):
+                start = 0
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in ("set_env", "setdefault")
+                        and node.args):
+                    name = knob_of(node.args[0])
+                    if name:
+                        knob(name).producers.append((path, node.lineno))
+                        start = 1
+                for arg in node.args[start:]:
+                    name = knob_of(arg)
+                    if name:
+                        knob(name).consumers.append((path, arg.lineno))
+                for kw in node.keywords:
+                    name = knob_of(kw.value)
+                    if name:
+                        knob(name).consumers.append((path, kw.value.lineno))
+            elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load):
+                name = knob_of(node.slice)
+                if name:
+                    knob(name).consumers.append((path, node.lineno))
+            elif isinstance(node, ast.Compare) and any(
+                    isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+                name = knob_of(node.left)
+                if name:
+                    knob(name).consumers.append((path, node.lineno))
+
+    for k in knobs.values():
+        k.producers.sort()
+        k.consumers.sort()
+        sites = list(k.producers) + list(k.consumers)
+        if k.const_site is not None:
+            sites.append(k.const_site)
+        k.exempt = any(
+            by_path[p].is_exempt(line, RULE_KNOB)
+            for p, line in sites if p in by_path)
+    return knobs
+
+
+# ---------------------------------------------------------------------------
+# (c) tpujob_* metrics
+
+
+def _extract_metrics(infos: Sequence[_FileInfo]) -> Dict[str, Metric]:
+    metrics: Dict[str, Metric] = {}
+    for info in sorted(infos, key=lambda i: i.rel_path):
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("counter", "gauge")):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value.startswith(METRIC_PREFIX)):
+                continue
+            name = node.args[0].value
+            if name in metrics:  # first registration wins
+                continue
+            label_node = None
+            if len(node.args) > 2:
+                label_node = node.args[2]
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "label_names":
+                        label_node = kw.value
+            labels = []
+            if isinstance(label_node, (ast.Tuple, ast.List)):
+                labels = [e.value for e in label_node.elts
+                          if isinstance(e, ast.Constant)
+                          and isinstance(e.value, str)]
+            metric = Metric(name, node.func.attr, labels,
+                            info.rel_path, node.lineno)
+            metric.exempt = info.is_exempt(node.lineno, RULE_METRIC)
+            metrics[name] = metric
+    return metrics
+
+
+def _scan_doc(text: str) -> Dict[str, int]:
+    documented: Dict[str, int] = {}
+    for lineno, line in enumerate(text.splitlines(), 1):
+        for m in _METRIC_DOC_RE.finditer(line):
+            documented.setdefault(m.group(0), lineno)
+    return documented
+
+
+# ---------------------------------------------------------------------------
+# (d) JobConditionType members and their write sites
+
+
+def _extract_conditions(infos: Sequence[_FileInfo]) -> Dict[str, Condition]:
+    conditions: Dict[str, Condition] = {}
+    for info in sorted(infos, key=lambda i: i.rel_path):
+        for node in ast.walk(info.tree):
+            if not (isinstance(node, ast.ClassDef)
+                    and node.name == CONDITION_ENUM):
+                continue
+            for stmt in node.body:
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and isinstance(stmt.value, ast.Constant)):
+                    member = stmt.targets[0].id
+                    if member not in conditions:
+                        cond = Condition(member, info.rel_path, stmt.lineno)
+                        cond.exempt = info.is_exempt(stmt.lineno, RULE_STATE)
+                        conditions[member] = cond
+
+    for info in infos:
+        consts = module_string_consts(info.tree)
+        for node, fn in _walk_with_fn(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _type_name(node.func)
+            verb = CONDITION_CALLS.get(callee or "")
+            if verb is None:
+                continue
+            member = _type_name(_call_arg(node, 1, "ctype"))
+            if member is None or member not in conditions:
+                continue
+            cond = conditions[member]
+            reasons = reason_candidates(_call_arg(node, 2, "reason"),
+                                        consts, fn)
+            target = (cond.set_reasons if verb == "set"
+                      else cond.clear_reasons)
+            for reason in reasons or ():
+                if reason:
+                    target.add(reason)
+            if verb == "set":
+                cond.set_sites += 1
+    return conditions
+
+
+# ---------------------------------------------------------------------------
+# public API
+
+
+def build_contract(files, doc=None) -> Contract:
+    """Extract the contract surface.
+
+    `files` is a sequence of (rel_path, source) or (rel_path, source, tree)
+    tuples; unparseable files are skipped (the lint reports them as
+    parse-error findings separately).  `doc` is an optional
+    (display_path, text) pair for docs/monitoring.md.
+    """
+    infos = []
+    for item in files:
+        rel_path, source = item[0], item[1]
+        tree = item[2] if len(item) > 2 else None
+        fi = _FileInfo(rel_path, source, tree)
+        if fi.tree is not None:
+            infos.append(fi)
+    modules, wire_types = _extract_wire(infos)
+    contract = Contract(
+        serializer_modules=modules,
+        wire_types=wire_types,
+        knobs=_extract_knobs(infos),
+        metrics=_extract_metrics(infos),
+        conditions=_extract_conditions(infos),
+    )
+    if doc is not None:
+        contract.doc_path = doc[0]
+        contract.documented = _scan_doc(doc[1])
+    return contract
+
+
+def contract_findings(contract: Contract):
+    """[(rule, path, line, message), ...] derived from the contract."""
+    out = []
+    for name in sorted(contract.wire_types):
+        wt = contract.wire_types[name]
+        for f in wt.fields.values():
+            if f.exempt or (f.to and f.frm):
+                continue
+            if f.to:
+                what = "serialized by *_to_dict but never restored by *_from_dict"
+            elif f.frm:
+                what = "restored by *_from_dict but never serialized by *_to_dict"
+            else:
+                what = "declared but serialized in neither direction"
+            out.append((RULE_WIRE, wt.path, f.line,
+                        f"wire field '{name}.{f.name}' is {what} "
+                        f"(fix the serializer or annotate "
+                        f"`# contract: exempt({RULE_WIRE})` with why)"))
+    for name in sorted(contract.knobs):
+        k = contract.knobs[name]
+        if k.exempt:
+            continue
+        if k.producers and not k.consumers:
+            path, line = k.producers[0]
+            out.append((RULE_KNOB, path, line,
+                        f"env knob '{name}' is produced but never consumed "
+                        f"(no reader in the scanned tree)"))
+        elif k.consumers and not k.producers:
+            path, line = k.consumers[0]
+            out.append((RULE_KNOB, path, line,
+                        f"env knob '{name}' is consumed but never produced "
+                        f"(annotate `# contract: exempt({RULE_KNOB})` for "
+                        f"user-set overrides)"))
+        elif not k.producers and not k.consumers and k.const_site is not None:
+            path, line = k.const_site
+            out.append((RULE_KNOB, path, line,
+                        f"env knob '{name}' is declared but never produced "
+                        f"or consumed"))
+    for name in sorted(contract.metrics):
+        m = contract.metrics[name]
+        if m.exempt:
+            continue
+        if name not in contract.documented:
+            out.append((RULE_METRIC, m.path, m.line,
+                        f"metric '{name}' is emitted but not documented in "
+                        f"docs/monitoring.md"))
+    if contract.doc_path is not None:
+        for name in sorted(contract.documented):
+            if name not in contract.metrics:
+                out.append((RULE_METRIC, contract.doc_path,
+                            contract.documented[name],
+                            f"metric '{name}' is documented but never "
+                            f"emitted by the package"))
+    for name in sorted(contract.conditions):
+        cond = contract.conditions[name]
+        if cond.exempt or cond.set_sites:
+            continue
+        out.append((RULE_STATE, cond.path, cond.line,
+                    f"condition '{name}' is declared but never set at any "
+                    f"condition-write site"))
+    out.sort(key=lambda f: (f[1], f[2], f[0], f[3]))
+    return out
+
+
+def manifest_dict(contract: Contract) -> dict:
+    """The canonical manifest document (stable: no line numbers, sorted
+    keys, deduplicated module paths) — what gets committed to
+    docs/interface-manifest.json and diff-gated in CI."""
+    wire = {}
+    for name, wt in sorted(contract.wire_types.items()):
+        wire[name] = {
+            "module": wt.path,
+            "fields": {
+                f.name: {"to": f.to, "from": f.frm, "exempt": f.exempt}
+                for f in wt.fields.values()
+            },
+        }
+    knobs = {}
+    for name, k in sorted(contract.knobs.items()):
+        knobs[name] = {
+            "constant": k.constant,
+            "producers": sorted({p for p, _line in k.producers}),
+            "consumers": sorted({p for p, _line in k.consumers}),
+            "exempt": k.exempt,
+        }
+    metrics = {}
+    for name, m in sorted(contract.metrics.items()):
+        metrics[name] = {
+            "kind": m.kind,
+            "labels": list(m.labels),
+            "module": m.path,
+            "documented": name in contract.documented,
+        }
+    conditions = {}
+    for name, cond in sorted(contract.conditions.items()):
+        conditions[name] = {
+            "set_reasons": sorted(cond.set_reasons),
+            "clear_reasons": sorted(cond.clear_reasons),
+            "set": cond.set_sites,
+        }
+    return {
+        "version": MANIFEST_VERSION,
+        "schema": MANIFEST_SCHEMA,
+        "serializers": list(contract.serializer_modules),
+        "wire": wire,
+        "knobs": knobs,
+        "metrics": metrics,
+        "conditions": conditions,
+        "doc": contract.doc_path,
+    }
+
+
+def diff_summary(committed, regenerated, prefix: str = "") -> List[str]:
+    """Human-readable recursive diff of two manifest documents."""
+    lines: List[str] = []
+    if isinstance(committed, dict) and isinstance(regenerated, dict):
+        for key in sorted(set(committed) | set(regenerated), key=str):
+            sub = f"{prefix}.{key}" if prefix else str(key)
+            if key not in committed:
+                lines.append(f"{sub}: only in regenerated manifest")
+            elif key not in regenerated:
+                lines.append(f"{sub}: only in committed manifest")
+            else:
+                lines.extend(diff_summary(committed[key], regenerated[key], sub))
+    elif committed != regenerated:
+        lines.append(f"{prefix}: committed {committed!r} != "
+                     f"regenerated {regenerated!r}")
+    return lines

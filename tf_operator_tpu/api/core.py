@@ -39,11 +39,14 @@ class ObjectMeta:
     annotations: Dict[str, str] = field(default_factory=dict)
     # Owner reference: (kind, name, uid) of the controlling TPUJob, used for
     # adoption/orphaning (ref: vendor/.../control/controller_ref_manager.go).
-    owner_kind: str = ""
-    owner_name: str = ""
-    owner_uid: str = ""
-    creation_timestamp: float = field(default_factory=time.time)
-    deletion_timestamp: Optional[float] = None
+    # Not wire fields: the cluster backend stamps owner refs and timestamps
+    # server-side (like k8s ownerReferences/creationTimestamp); a TPUJob
+    # manifest round trip intentionally drops them.
+    owner_kind: str = ""  # contract: exempt(wire-roundtrip)
+    owner_name: str = ""  # contract: exempt(wire-roundtrip)
+    owner_uid: str = ""  # contract: exempt(wire-roundtrip)
+    creation_timestamp: float = field(default_factory=time.time)  # contract: exempt(wire-roundtrip)
+    deletion_timestamp: Optional[float] = None  # contract: exempt(wire-roundtrip)
 
     def controlled_by(self, kind: str, uid: str) -> bool:
         return self.owner_kind == kind and self.owner_uid == uid
@@ -107,8 +110,9 @@ class PodTemplateSpec:
     scheduler_name: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
     # set by the scheduler at binding time (pods/binding subresource on the
-    # k8s backend); non-empty means the pod has been scheduled onto a node
-    node_name: str = ""
+    # k8s backend); non-empty means the pod has been scheduled onto a node —
+    # runtime state, never part of the TPUJob template wire format
+    node_name: str = ""  # contract: exempt(wire-roundtrip)
     extra: Dict[str, Any] = field(default_factory=dict)  # volumes, affinity, ... passthrough
 
     def container(self, *names: str) -> Optional[Container]:

@@ -105,12 +105,17 @@ def _template_to_dict(t: PodTemplateSpec) -> Dict[str, Any]:
                         for p in c.ports
                     ],
                     "resources": {"limits": dict(c.resources)},
+                    # volumeMounts, probes, ... passthrough survives the
+                    # round trip
+                    **dict(c.extra),
                 }
                 for c in t.containers
             ],
             "restartPolicy": t.restart_policy,
             "schedulerName": t.scheduler_name,
             "nodeSelector": dict(t.node_selector),
+            # volumes, affinity, ... passthrough survives the round trip
+            **dict(t.extra),
         },
     }
 
@@ -149,6 +154,7 @@ def status_to_dict(status: JobStatus) -> Dict[str, Any]:
         },
         "startTime": status.start_time,
         "completionTime": status.completion_time,
+        "lastReconcileTime": status.last_reconcile_time,
         "zeroShardingPlan": status.zero_sharding_plan,
         "elastic": status.elastic,
     }
@@ -255,6 +261,11 @@ def _template_from_dict(data: Dict[str, Any]) -> PodTemplateSpec:
                     for p in (c_raw.get("ports") or [])
                 ],
                 resources={k: float(v) for k, v in limits.items()},
+                extra={
+                    k: v for k, v in c_raw.items()
+                    if k not in ("name", "image", "command", "args", "env",
+                                 "ports", "resources")
+                },
             )
         )
     return PodTemplateSpec(
@@ -320,6 +331,7 @@ def status_from_dict(data: Dict[str, Any]) -> JobStatus:
         replica_statuses=replica_statuses,
         start_time=data.get("startTime"),
         completion_time=data.get("completionTime"),
+        last_reconcile_time=data.get("lastReconcileTime"),
         zero_sharding_plan=data.get("zeroShardingPlan"),
         elastic=data.get("elastic"),
     )

@@ -270,7 +270,9 @@ class TPUJob:
     spec: TPUJobSpec = field(default_factory=TPUJobSpec)
     status: JobStatus = field(default_factory=JobStatus)
 
-    kind: str = "TPUJob"
+    # constant discriminator: job_to_dict emits constants.KIND and
+    # job_from_dict never restores it — not a round-tripped field
+    kind: str = "TPUJob"  # contract: exempt(wire-roundtrip)
 
     def deepcopy(self) -> "TPUJob":
         return copy.deepcopy(self)

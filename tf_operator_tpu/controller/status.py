@@ -41,7 +41,7 @@ from ..utils import clock, metrics
 JOB_RUNNING_REASON = "TPUJobRunning"
 JOB_SUCCEEDED_REASON = "TPUJobSucceeded"
 JOB_FAILED_REASON = "TPUJobFailed"
-JOB_RESTARTING_REASON = "TPUJobRestarting"
+JOB_RESTARTING_REASON = "JobRestarting"
 
 
 def is_worker0_completed(job: TPUJob, pods) -> bool:

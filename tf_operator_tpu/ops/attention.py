@@ -792,10 +792,12 @@ def default_blocks(block_q, block_k):
     Resolution lives ONLY at the _flash_forward/_flash_backward
     chokepoints so every public entry (flash_attention,
     flash_attention_lse, the interpret helpers) shares one rule."""
+    # tuned-config handoff knobs: written by the autotune bench / the user,
+    # not by gen_tpu_env (ops/autotune.py module docstring)
     if block_q is None:
-        block_q = _env_block("TPUJOB_FLASH_BLOCK_Q", 8)
+        block_q = _env_block("TPUJOB_FLASH_BLOCK_Q", 8)  # contract: exempt(knob-chain)
     if block_k is None:
-        block_k = _env_block("TPUJOB_FLASH_BLOCK_K", 128)
+        block_k = _env_block("TPUJOB_FLASH_BLOCK_K", 128)  # contract: exempt(knob-chain)
     return block_q, block_k
 
 

@@ -49,7 +49,8 @@ _KERNEL_HASH: Optional[str] = None
 
 
 def _cache_path() -> Optional[str]:
-    return os.environ.get("TPUJOB_AUTOTUNE_CACHE") or None
+    # bench-operator-set cache location, never injected by gen_tpu_env
+    return os.environ.get("TPUJOB_AUTOTUNE_CACHE") or None  # contract: exempt(knob-chain)
 
 
 def _kernel_source_hash() -> str:

@@ -29,7 +29,8 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--transport",
         choices=("python", "native"),
-        default=os.environ.get("TPUJOB_PS_TRANSPORT", "python"),
+        # user-set default override, never injected by gen_tpu_env
+        default=os.environ.get("TPUJOB_PS_TRANSPORT", "python"),  # contract: exempt(knob-chain)
         help="PS wire transport: python (pickle sockets) or native (C++ "
              "shard server, binary protocol)",
     )

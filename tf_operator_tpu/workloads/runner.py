@@ -87,7 +87,8 @@ def apply_forced_platform(env: Optional[Dict[str, str]] = None) -> None:
 
     Must run before the first jax backend initialization in the pod process.
     """
-    forced = (os.environ if env is None else env).get("TPUJOB_FORCE_PLATFORM")
+    # user/test-set override, never injected by gen_tpu_env
+    forced = (os.environ if env is None else env).get("TPUJOB_FORCE_PLATFORM")  # contract: exempt(knob-chain)
     if forced:
         import jax
 
