@@ -924,7 +924,10 @@ def child_zero() -> None:
     plan = build_zero_plan(
         shapes, mesh, base_specs=make_param_shardings(shapes, mesh))
 
+    from tf_operator_tpu.analysis.hlo import collective_signature_from_text
+
     timers = {}
+    sig_hashes = {}
     for arm, arm_plan in (("off", None), ("on", plan)):
         tx = lm_optimizer(3e-4, zero_plan=arm_plan,
                           mesh=mesh if arm_plan is not None else None)
@@ -932,6 +935,14 @@ def child_zero() -> None:
             jax.random.PRNGKey(0), model, tx, example, zero_plan=arm_plan)
         state = shard_train_state(state, mesh, zero_plan=arm_plan)
         raw = make_train_step(lm_loss_fn(model.apply), jit=False)
+        # Per-arm collective signature (analysis/hlo.py): the hash pins
+        # WHICH communication pattern each throughput number measured, so
+        # an A/B regression can be told apart from a partitioner change.
+        # lower+compile only — no execution, so the donation never fires
+        # and `state` stays live for the timer below.
+        text = jax.jit(raw, donate_argnums=(0,)).lower(
+            state, batch).compile().as_text()
+        _, sig_hashes[arm] = collective_signature_from_text(text)
         timers[arm] = _window_timer(raw, state, batch, steps)
     # Interleaved windows, same discipline as the main arm: both arms see
     # the same instantaneous host conditions, ratio is per-pair median.
@@ -952,6 +963,8 @@ def child_zero() -> None:
         "zero_on_tokens_per_sec": round(statistics.median(on_w), 2),
         "zero_off_tokens_per_sec": round(statistics.median(off_w), 2),
         "zero_on_vs_off": round(statistics.median(ratios), 4),
+        "zero_on_collective_signature": sig_hashes["on"],
+        "zero_off_collective_signature": sig_hashes["off"],
     }))
 
 

@@ -97,7 +97,15 @@ def run_lint_tier(junit_dir: str, paths: list[str]) -> int:
     (`--manifest`, docs/static-analysis.md#interface-manifest) into
     `interface-manifest.json` next to the findings documents and
     diff-gates it against the committed docs/interface-manifest.json --
-    contract drift fails the tier exactly like a finding would."""
+    contract drift fails the tier exactly like a finding would.
+
+    Compiled-program (HLO) pass, gated: set ANALYSIS_HLO_BUDGET=<devices>
+    (>= 2) and the default run additionally captures the four train
+    workloads on that many CPU virtual devices, lints the compiled
+    programs (docs/static-analysis.md#hlo-rules) into `hlo-findings.json`
+    and diff-gates the collective-signature snapshot against the
+    committed docs/hlo-manifest.json.  Off by default — lowering and
+    compiling four models costs minutes; ci.yaml turns it on."""
     if paths:
         targets = [(p if os.path.isabs(p) else os.path.join(ROOT, p), [])
                    for p in paths]
@@ -128,6 +136,9 @@ def run_lint_tier(junit_dir: str, paths: list[str]) -> int:
     race_schedules = None
     manifest_json = None
     manifest_diff = None
+    hlo_devices = None
+    hlo_json = None
+    hlo_status = None
     if not paths:
         race_schedules = int(os.environ.get("ANALYSIS_EXPLORE_BUDGET", "150"))
         race_json = os.path.join(junit_dir, "race-findings.json")
@@ -147,6 +158,19 @@ def run_lint_tier(junit_dir: str, paths: list[str]) -> int:
         manifest_rc = subprocess.call(cmd, cwd=ROOT, env=env)
         manifest_diff = "clean" if manifest_rc == 0 else "drift"
         rc |= manifest_rc
+        budget = int(os.environ.get("ANALYSIS_HLO_BUDGET", "0") or 0)
+        if budget >= 2:
+            hlo_devices = budget
+            hlo_json = os.path.join(junit_dir, "hlo-findings.json")
+            findings_json.append(hlo_json)
+            committed_hlo = os.path.join(REPO, "docs", "hlo-manifest.json")
+            cmd = [sys.executable, "-m", "tf_operator_tpu.analysis",
+                   "--hlo", "all", "--devices", str(budget),
+                   "--json", hlo_json, "--diff", committed_hlo]
+            print("+", " ".join(cmd), flush=True)
+            hlo_rc = subprocess.call(cmd, cwd=ROOT, env=env)
+            hlo_status = "pass" if hlo_rc == 0 else "fail"
+            rc |= hlo_rc
     status = "pass" if rc == 0 else "fail"
     with open(os.path.join(junit_dir, "lint-summary.json"), "w") as f:
         json.dump({"tier": "lint", "attempts": 1, "status": status,
@@ -154,6 +178,9 @@ def run_lint_tier(junit_dir: str, paths: list[str]) -> int:
                    "race_schedules": race_schedules,
                    "manifest_json": manifest_json,
                    "manifest_diff": manifest_diff,
+                   "hlo_devices": hlo_devices,
+                   "hlo_json": hlo_json,
+                   "hlo_status": hlo_status,
                    "findings_json": findings_json}, f, indent=2)
     print(f"RESULT tier=lint attempts=1 status={status}", flush=True)
     return 0 if rc == 0 else 1

@@ -1,0 +1,196 @@
+"""Shared builders for the bad_hlo_* capture fixtures.
+
+Each bad_hlo_*.py fixture is one deliberately broken (or deliberately
+constrained) tiny linear-model train step whose compiled HLO fires exactly
+one of the four compiled-program rules (docs/static-analysis.md#hlo-rules).
+The base builder here is the CORRECT program — the real TrainState /
+make_train_step / zero_shard_optimizer machinery at toy shapes — and the
+fixtures derive their specific defect from it, so a fixture can only fire
+the rule its one twist introduces.
+
+Not a lint target itself (the lint tier excludes lint_fixtures); loaded by
+the fixtures via a sys.path insert because the fixture directory is not a
+package.
+"""
+from __future__ import annotations
+
+
+def good_capture(num_devices, *, overlap=False, budget_bytes=0,
+                 opt_replicated=False, workload="hlo-fixture"):
+    """Capture the correct tiny ZeRO train step.
+
+    overlap=True marks every sharded plan entry overlappable (arms
+    hlo-sync-collective on backends that compile gathers synchronously);
+    budget_bytes declares a per-device memory budget (arms
+    hlo-memory-infeasible when the program cannot fit); opt_replicated=True
+    passes the optimizer state in REPLICATED while the declared plan —
+    which the expectation is always computed from — says sharded (arms
+    hlo-replicated-optstate).
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tf_operator_tpu.analysis import hlo
+    from tf_operator_tpu.parallel.mesh import batch_sharding, build_mesh
+    from tf_operator_tpu.train import zero as zero_lib
+    from tf_operator_tpu.train.state import TrainState
+    from tf_operator_tpu.train.step import make_train_step
+
+    mesh = build_mesh({"dp": num_devices})
+    shapes = {
+        "w": jax.ShapeDtypeStruct((64, 32), jnp.float32),
+        "b": jax.ShapeDtypeStruct((32,), jnp.float32),
+    }
+    base = {key: NamedSharding(mesh, P()) for key in shapes}
+    plan = zero_lib.build_zero_plan(shapes, mesh, base_specs=base)
+    if overlap:
+        plan = plan.with_overlap()
+    tx = zero_lib.zero_shard_optimizer(
+        optax.sgd(0.1, momentum=0.9), plan, mesh)
+
+    def loss_fn(params, batch, rngs=None):
+        logits = batch["x"] @ params["w"] + params["b"]
+        return jnp.mean((logits - batch["y"]) ** 2), {}
+
+    def init_state(params):
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            opt_state=tx.init(params), apply_fn=None, tx=tx,
+            zero_plan=plan)
+
+    state_shape = jax.eval_shape(init_state, shapes)
+    opt_shape = jax.eval_shape(tx.init, shapes)
+
+    def planned(leaf, entry):
+        return NamedSharding(mesh, entry.spec if entry is not None else P())
+
+    planned_opt_sh = zero_lib._map_with_plan(opt_shape, plan, planned)
+    actual_opt_sh = planned_opt_sh
+    if opt_replicated:
+        actual_opt_sh = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()), opt_shape)
+
+    def state_sharding(opt_sh):
+        return TrainState(
+            step=NamedSharding(mesh, P()), params=base, opt_state=opt_sh,
+            apply_fn=None, tx=tx, zero_plan=plan)
+
+    batch_shape = {
+        "x": jax.ShapeDtypeStruct((4 * num_devices, 64), jnp.float32),
+        "y": jax.ShapeDtypeStruct((4 * num_devices, 32), jnp.float32),
+    }
+    batch_sh = {key: batch_sharding(mesh) for key in batch_shape}
+    step = make_train_step(loss_fn, jit=False)
+    program, memory = hlo.capture_program(
+        step, (state_shape, batch_shape),
+        (state_sharding(actual_opt_sh), batch_sh))
+    return hlo.HloCapture(
+        workload=workload,
+        num_devices=num_devices,
+        zero=True,
+        plan=plan,
+        program=program,
+        memory=memory,
+        moments_per_param=1,
+        expected_args=(
+            hlo.expected_entry_shapes(
+                state_shape, state_sharding(planned_opt_sh))
+            + hlo.expected_entry_shapes(batch_shape, batch_sh)),
+        update_pairs=hlo.plan_update_pairs(plan, shapes, base),
+        opt_bytes_per_device=zero_lib.opt_state_bytes_per_device(
+            plan, shapes, moments_per_param=1),
+        params_bytes_per_device=sum(
+            s.size * s.dtype.itemsize for s in shapes.values()),
+        anchor_file=__file__,
+        anchor_path="tests/lint_fixtures/_hlo_fixture_lib.py",
+        anchor_line=1,
+        device_memory_budget_bytes=budget_bytes,
+    )
+
+
+def drift_capture(num_devices, workload="hlo-fixture"):
+    """The plan-drift program: a declared ZeRO plan, but the step neither
+    reduces gradients nor gathers the updated shards back — the momentum
+    advances shard-locally and the params never see the update.  The
+    compiled program therefore has NO collectives at all, while the plan
+    demands one weight-update all-gather per sharded entry and a gradient
+    reduction.  Optimizer state itself is laid out exactly per plan, so
+    only hlo-plan-drift fires."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tf_operator_tpu.analysis import hlo
+    from tf_operator_tpu.parallel.mesh import build_mesh
+    from tf_operator_tpu.train import zero as zero_lib
+
+    mesh = build_mesh({"dp": num_devices})
+    shapes = {
+        "w": jax.ShapeDtypeStruct((64, 32), jnp.float32),
+        "b": jax.ShapeDtypeStruct((32,), jnp.float32),
+    }
+    base = {key: NamedSharding(mesh, P()) for key in shapes}
+    plan = zero_lib.build_zero_plan(shapes, mesh, base_specs=base)
+
+    def step(state, batch):
+        def loss_of(params):
+            return jnp.mean((params["w"] - batch["x"]) ** 2) + jnp.mean(
+                (params["b"] - batch["y"]) ** 2)
+
+        grads = jax.grad(loss_of)(state["params"])
+        # the defect: grads sliced to shards and folded into the momentum,
+        # but never reduced across dp and never gathered back into the
+        # params — the declared plan's collectives simply do not exist
+        g_shard = zero_lib.constrain_to_plan(grads, plan, mesh)
+        mu = jax.tree_util.tree_map(
+            lambda m, g: 0.9 * m + g, state["mu"], g_shard)
+        new_state = {"step": state["step"] + 1,
+                     "params": state["params"], "mu": mu}
+        return new_state, {"loss": loss_of(state["params"])}
+
+    def plan_sharding(leaf, entry):
+        return NamedSharding(mesh, entry.spec if entry is not None else P())
+
+    mu_shape = shapes  # one momentum buffer mirroring each param
+    state_shape = {
+        "step": jax.ShapeDtypeStruct((), jnp.int32),
+        "params": shapes,
+        "mu": mu_shape,
+    }
+    state_sh = {
+        "step": NamedSharding(mesh, P()),
+        "params": base,
+        "mu": zero_lib._map_with_plan(mu_shape, plan, plan_sharding),
+    }
+    # batch replicated on purpose: data parallelism is what the broken
+    # step forgot, and a replicated batch keeps XLA from inserting the
+    # missing reduction on its own
+    batch_shape = {
+        "x": jax.ShapeDtypeStruct((64, 32), jnp.float32),
+        "y": jax.ShapeDtypeStruct((32,), jnp.float32),
+    }
+    batch_sh = {key: NamedSharding(mesh, P()) for key in batch_shape}
+    program, memory = hlo.capture_program(
+        step, (state_shape, batch_shape), (state_sh, batch_sh))
+    return hlo.HloCapture(
+        workload=workload,
+        num_devices=num_devices,
+        zero=True,
+        plan=plan,
+        program=program,
+        memory=memory,
+        moments_per_param=1,
+        expected_args=(
+            hlo.expected_entry_shapes(state_shape, state_sh)
+            + hlo.expected_entry_shapes(batch_shape, batch_sh)),
+        update_pairs=hlo.plan_update_pairs(plan, shapes, base),
+        opt_bytes_per_device=zero_lib.opt_state_bytes_per_device(
+            plan, shapes, moments_per_param=1),
+        params_bytes_per_device=sum(
+            s.size * s.dtype.itemsize for s in shapes.values()),
+        anchor_file=__file__,
+        anchor_path="tests/lint_fixtures/_hlo_fixture_lib.py",
+        anchor_line=1,
+    )

@@ -7,6 +7,8 @@ import subprocess
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO = Path(__file__).resolve().parent.parent
 RUNNER = REPO / "build" / "run_tests.py"
 
@@ -73,6 +75,7 @@ def test_lint_tier_passes_on_clean_repo_package(tmp_path):
     and no pytest/junit machinery involved."""
     env = dict(os.environ)
     env["ANALYSIS_EXPLORE_BUDGET"] = "20"  # keep the sweep test-sized
+    env["ANALYSIS_HLO_BUDGET"] = "0"       # compiled-program pass gated off
     proc = subprocess.run(
         [sys.executable, str(RUNNER), "--tier", "lint",
          "--root", str(tmp_path), "--junit-dir", "junit"],
@@ -114,6 +117,11 @@ def test_lint_tier_passes_on_clean_repo_package(tmp_path):
     assert manifest["schema"] == "tf-operator-tpu/interface-manifest"
     assert "interface manifest matches" in proc.stdout
     assert not (tmp_path / "junit" / "lint.xml").exists()
+    # the compiled-program pass stays off without ANALYSIS_HLO_BUDGET
+    assert summary["hlo_devices"] is None
+    assert summary["hlo_json"] is None
+    assert summary["hlo_status"] is None
+    assert not (tmp_path / "junit" / "hlo-findings.json").exists()
 
 
 def test_lint_tier_fails_on_findings(tmp_path):
@@ -140,6 +148,38 @@ def test_lint_tier_fails_on_findings(tmp_path):
         (tmp_path / "junit" / "lint-findings.json").read_text())
     assert doc["count"] == 1
     assert doc["findings"][0]["rule"] == "bare-lock"
+
+
+@pytest.mark.slow
+def test_lint_tier_hlo_gate_on(tmp_path):
+    """ANALYSIS_HLO_BUDGET=4 adds the compiled-program pass: the four
+    train workloads lint clean, hlo-findings.json lands next to the other
+    findings documents, and the collective-signature snapshot matches the
+    committed docs/hlo-manifest.json."""
+    # drop the test session's own virtual-device fan-out: the capture
+    # subprocess sets its device count itself (like the bare CI env)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["ANALYSIS_EXPLORE_BUDGET"] = "20"
+    env["ANALYSIS_HLO_BUDGET"] = "4"  # must match the committed manifest
+    proc = subprocess.run(
+        [sys.executable, str(RUNNER), "--tier", "lint",
+         "--root", str(tmp_path), "--junit-dir", "junit"],
+        capture_output=True, text=True, env=env, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 HLO finding(s)" in proc.stdout
+    assert "HLO manifest matches" in proc.stdout
+    summary = json.loads(
+        (tmp_path / "junit" / "lint-summary.json").read_text())
+    assert summary["hlo_devices"] == 4
+    assert summary["hlo_status"] == "pass"
+    hlo_json = tmp_path / "junit" / "hlo-findings.json"
+    assert summary["hlo_json"] == str(hlo_json)
+    assert summary["findings_json"][-1] == str(hlo_json)
+    doc = json.loads(hlo_json.read_text())
+    assert doc["count"] == 0 and doc["findings"] == []
+    assert doc["target"] == "hlo:all"
 
 
 def test_crashing_retry_is_not_a_pass(tmp_path, monkeypatch):

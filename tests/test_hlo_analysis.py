@@ -1,0 +1,377 @@
+"""Compiled-program (HLO) lint layer: parser, the four rules, admission
+math, manifest stability, fixture pins, and the plan-doc/HLO agreement
+e2e pin.
+
+Fast tests work on canned HLO text and synthetic captures — no compile.
+Tests that lower+compile real programs (fixture pins, workload clean runs,
+the e2e pin) are in the compile-marked classes; the heavyweight ones are
+`slow`, matching the repo's tiering.
+"""
+import json
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+from tf_operator_tpu.analysis import hlo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+# A hand-written per-device SPMD module exercising every parser feature:
+# sync + async collectives, iota and explicit replica groups, a start
+# whose result tuple echoes its operand, op_name metadata, ENTRY params.
+CANNED_HLO = """\
+HloModule jit_step, entry_computation_layout={(f32[16,32]{1,0}, f32[8]{0})->f32[64,32]{1,0}}
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %r = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main.42 (param.0: f32[16,32], param.1: f32[8], param.2: s32[]) -> f32[64,32] {
+  %param.0 = f32[16,32]{1,0} parameter(0)
+  %param.1 = f32[8]{0} parameter(1)
+  %param.2 = s32[] parameter(2)
+  %all-reduce.1 = f32[16,32]{1,0} all-reduce(f32[16,32]{1,0} %param.0), channel_id=1, replica_groups=[1,4]<=[4], to_apply=%add, metadata={op_name="jit(step)/grad-sum"}
+  %all-gather-start.2 = (f32[16,32]{1,0}, f32[64,32]{1,0}) all-gather-start(f32[16,32]{1,0} %all-reduce.1), channel_id=2, replica_groups={{0,1,2,3}}, dimensions={0}
+  %all-gather-done.2 = f32[64,32]{1,0} all-gather-done((f32[16,32]{1,0}, f32[64,32]{1,0}) %all-gather-start.2)
+  %all-gather.3 = f32[32]{0} all-gather(f32[8]{0} %param.1), channel_id=3, replica_groups=[1,4]<=[4], dimensions={0}
+  ROOT %out = f32[64,32]{1,0} copy(f32[64,32]{1,0} %all-gather-done.2)
+}
+"""
+
+
+def canned_program():
+    return hlo.parse_hlo(CANNED_HLO)
+
+
+def make_capture(tmp_path, program=None, *, pairs=(), expected=(),
+                 budget=0, memory=None, anchor_text="def main():\n"):
+    """Synthetic HloCapture over a throwaway anchor file."""
+    anchor = tmp_path / "anchor.py"
+    anchor.write_text(anchor_text)
+    plan = types.SimpleNamespace(axis="dp", num_shards=4, entries=pairs)
+    return hlo.HloCapture(
+        workload="synthetic", num_devices=4, zero=True, plan=plan,
+        program=program if program is not None else canned_program(),
+        memory=memory, moments_per_param=2,
+        expected_args=tuple(expected), update_pairs=tuple(pairs),
+        opt_bytes_per_device=0, params_bytes_per_device=0,
+        anchor_file=str(anchor), anchor_path="anchor.py", anchor_line=1,
+        device_memory_budget_bytes=budget)
+
+
+class TestParser:
+    def test_collective_inventory(self):
+        program = canned_program()
+        kinds = sorted(op.kind for op in program.collectives)
+        assert kinds == ["all-gather", "all-gather", "all-reduce"]
+        assert program.unpaired_starts == 0
+
+        ar = program.by_kind("all-reduce")[0]
+        assert ar.name == "all-reduce.1"
+        assert not ar.asynchronous
+        assert ar.num_groups == 1 and ar.group_size == 4
+        assert ar.result_shapes == (("f32", (16, 32)),)
+        assert ar.bytes_moved == 16 * 32 * 4
+        assert ar.op_name == "jit(step)/grad-sum"
+
+    def test_async_start_drops_operand_echo(self):
+        start = [op for op in canned_program().by_kind("all-gather")
+                 if op.asynchronous][0]
+        # the start result tuple repeats the operand buffer; only the
+        # gathered shape is the real result
+        assert start.operand_shapes == (("f32", (16, 32)),)
+        assert start.result_shapes == (("f32", (64, 32)),)
+        assert start.num_groups == 1 and start.group_size == 4
+
+    def test_entry_params(self):
+        program = canned_program()
+        assert program.entry_params == (
+            ("f32", (16, 32)), ("f32", (8,)), ("s32", ()))
+
+    def test_unpaired_start_counted(self):
+        text = CANNED_HLO.replace(
+            "  %all-gather-done.2 = f32[64,32]{1,0} all-gather-done("
+            "(f32[16,32]{1,0}, f32[64,32]{1,0}) %all-gather-start.2)\n", "")
+        assert hlo.parse_hlo(text).unpaired_starts == 1
+
+    def test_shape_bytes(self):
+        assert hlo.shape_bytes(("f32", (16, 32))) == 2048
+        assert hlo.shape_bytes(("bf16", (8,))) == 16
+        assert hlo.shape_bytes(("s32", ())) == 4
+
+
+class TestRules:
+    def pairs(self, overlap=False):
+        return (hlo.PlanPair(shard_dims=(16, 32), base_dims=(64, 32),
+                             overlap=overlap),
+                hlo.PlanPair(shard_dims=(8,), base_dims=(32,),
+                             overlap=overlap))
+
+    def test_clean_program_no_findings(self, tmp_path):
+        cap = make_capture(
+            tmp_path, pairs=self.pairs(),
+            expected=(("f32", (16, 32)), ("f32", (8,)), ("s32", ())))
+        assert hlo.check_capture(cap) == []
+
+    def test_plan_drift_missing_gather(self, tmp_path):
+        # demand two gathers of the large entry; the program supplies one
+        pairs = (hlo.PlanPair((16, 32), (64, 32), False),) * 2
+        findings = hlo.check_capture(make_capture(tmp_path, pairs=pairs))
+        assert [f.rule for f in findings] == [hlo.RULE_HLO_PLAN_DRIFT]
+        assert "1 of 2" in findings[0].message
+
+    def test_plan_drift_no_reduction(self, tmp_path):
+        text = CANNED_HLO.replace("all-reduce(", "copy(")
+        findings = hlo.check_capture(make_capture(
+            tmp_path, program=hlo.parse_hlo(text), pairs=self.pairs()))
+        assert [f.rule for f in findings] == [hlo.RULE_HLO_PLAN_DRIFT]
+        assert "no gradient reduction" in findings[0].message
+
+    def test_drift_accepts_reduce_scatter_form(self, tmp_path):
+        # backends that keep reduce-scatter satisfy the reduction demand
+        text = CANNED_HLO.replace("all-reduce(", "reduce-scatter(")
+        findings = hlo.check_capture(make_capture(
+            tmp_path, program=hlo.parse_hlo(text), pairs=self.pairs()))
+        assert findings == []
+
+    def test_replicated_optstate(self, tmp_path):
+        findings = hlo.check_capture(make_capture(
+            tmp_path, pairs=self.pairs(),
+            expected=(("f32", (16, 32)), ("f32", (8,)), ("f32", (2, 2)))))
+        assert [f.rule for f in findings] == [
+            hlo.RULE_HLO_REPLICATED_OPTSTATE]
+        assert "f32[2, 2]x1" in findings[0].message
+
+    def test_sync_collective_only_for_overlap_entries(self, tmp_path):
+        # the canned (8,)->(32,) gather is synchronous: flagged only when
+        # its plan entry promises overlap
+        sync_pair = (hlo.PlanPair((8,), (32,), True),)
+        findings = hlo.check_capture(make_capture(
+            tmp_path, pairs=sync_pair, expected=(("f32", (8,)),)))
+        assert [f.rule for f in findings] == [hlo.RULE_HLO_SYNC_COLLECTIVE]
+
+        # the async (16,32)->(64,32) gather satisfies overlap: clean
+        async_pair = (hlo.PlanPair((16, 32), (64, 32), True),)
+        assert hlo.check_capture(make_capture(
+            tmp_path, pairs=async_pair,
+            expected=(("f32", (16, 32)),))) == []
+
+    def test_memory_infeasible_budget(self, tmp_path):
+        memory = hlo.MemoryStats(argument_bytes=1000, output_bytes=900,
+                                 alias_bytes=800, temp_bytes=500)
+        assert memory.peak_bytes == 1000 + 500 + 100
+        cap = make_capture(tmp_path, budget=1024, memory=memory)
+        findings = hlo.check_capture(cap)
+        assert [f.rule for f in findings] == [hlo.RULE_HLO_MEMORY_INFEASIBLE]
+        assert hlo.check_capture(
+            make_capture(tmp_path, budget=10_000, memory=memory)) == []
+
+    def test_suppression_comment(self, tmp_path):
+        pairs = (hlo.PlanPair((16, 32), (64, 32), False),) * 2
+        cap = make_capture(
+            tmp_path, pairs=pairs,
+            anchor_text="def main():  # lint: allow(hlo-plan-drift)\n")
+        assert hlo.check_capture(cap) == []
+
+    def test_rules_filter(self, tmp_path):
+        pairs = (hlo.PlanPair((16, 32), (64, 32), False),) * 2
+        cap = make_capture(tmp_path, pairs=pairs)
+        assert hlo.check_capture(
+            cap, rules=[hlo.RULE_HLO_SYNC_COLLECTIVE]) == []
+
+
+class TestSignature:
+    def test_signature_and_hash_stable(self):
+        program = canned_program()
+        sig = hlo.collective_signature(program)
+        assert sig["all-reduce"]["count"] == 1
+        assert sig["all-reduce"]["syncCount"] == 1
+        assert sig["all-gather"]["count"] == 2
+        assert sig["all-gather"]["syncCount"] == 1  # one async, one sync
+        assert sig["all-gather"]["groupSizes"] == [4]
+        assert hlo.signature_hash(sig) == hlo.signature_hash(
+            hlo.collective_signature(canned_program()))
+
+    def test_signature_from_text_matches(self):
+        sig, digest = hlo.collective_signature_from_text(CANNED_HLO)
+        assert digest == hlo.signature_hash(sig)
+        assert len(digest) == 64
+
+    def test_render_manifest_canonical(self, tmp_path):
+        cap = make_capture(tmp_path)
+        manifest = hlo.build_manifest([cap])
+        text = hlo.render_manifest(manifest)
+        assert text.endswith("\n")
+        assert json.loads(text) == manifest
+        assert manifest["schema"] == hlo.HLO_MANIFEST_SCHEMA
+        assert manifest["workloads"]["synthetic"]["hash"] == (
+            hlo.signature_hash(hlo.workload_signature(cap)))
+
+
+class TestAdmissionMath:
+    def test_lower_bound_zero_divides_moments(self):
+        dense = hlo.admission_peak_lower_bound(1000, dp_shards=4)
+        sharded = hlo.admission_peak_lower_bound(
+            1000, dp_shards=4, zero=True)
+        assert dense == 1000 * 4 + 1000 * 4 + 1000 * 4 * 2
+        assert sharded == 1000 * 4 + 1000 * 4 + 1000 * 4 * 2 // 4
+
+    def test_model_parallel_divides_everything(self):
+        assert hlo.admission_peak_lower_bound(1000, model_parallel=2) == (
+            hlo.admission_peak_lower_bound(1000) // 2)
+
+    def test_memory_check_reasons(self):
+        from tf_operator_tpu.api.types import TPUTopology
+
+        # no declared budget -> never rejected
+        assert hlo.admission_memory_check(
+            TPUTopology(topology="2x2")) is None
+        assert hlo.admission_memory_check(None) is None
+
+        big = TPUTopology(topology="2x4", mesh={"dp": 8},
+                          device_memory_gb=8.0, model_params=10**9)
+        reason = hlo.admission_memory_check(big)
+        assert reason is not None and "zeroShardWeightUpdate" in reason
+
+        fits = TPUTopology(topology="2x4", mesh={"dp": 8},
+                           zero_shard_weight_update=True,
+                           device_memory_gb=10.0, model_params=10**9)
+        assert hlo.admission_memory_check(fits) is None
+
+    def test_rules_registered(self):
+        from tf_operator_tpu.analysis import ALL_RULES, rule_doc
+
+        for rule in hlo.HLO_RULES:
+            assert rule in ALL_RULES
+            assert rule_doc(rule).endswith("#hlo-rules")
+
+
+class TestFixturePins:
+    """Each known-bad fixture fires its rule exactly once under the FULL
+    rule set; the suppressed twin of every defect fires nothing.  Captures
+    run in-process on the test session's 8 virtual CPU devices."""
+
+    def check_fixture(self, stem):
+        captures = hlo.capture_from_file(
+            os.path.join(FIXTURES, stem + ".py"), num_devices=8)
+        findings = []
+        for cap in captures:
+            findings.extend(hlo.check_capture(cap))
+        return findings
+
+    @pytest.mark.parametrize("stem,rule", [
+        ("bad_hlo_plan_drift", hlo.RULE_HLO_PLAN_DRIFT),
+        ("bad_hlo_replicated_optstate", hlo.RULE_HLO_REPLICATED_OPTSTATE),
+        ("bad_hlo_sync_collective", hlo.RULE_HLO_SYNC_COLLECTIVE),
+        ("bad_hlo_memory_infeasible", hlo.RULE_HLO_MEMORY_INFEASIBLE),
+    ])
+    def test_bad_fixture_fires_exactly_once(self, stem, rule):
+        findings = self.check_fixture(stem)
+        assert [f.rule for f in findings] == [rule]
+        assert findings[0].path == f"tests/lint_fixtures/{stem}.py"
+
+    def test_suppressed_fixtures_fire_nothing(self):
+        assert self.check_fixture("suppressed_hlo_ok") == []
+
+
+@pytest.mark.slow
+class TestHloCli:
+    """End-to-end CLI invocations in fresh interpreters (the only way to
+    exercise _ensure_virtual_devices winning the pre-import race)."""
+
+    def run_cli(self, *argv, env_extra=None):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        env.update(env_extra or {})
+        return subprocess.run(
+            [sys.executable, "-m", "tf_operator_tpu.analysis", *argv],
+            cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
+
+    def test_fixture_exit_codes(self):
+        bad = self.run_cli(
+            "--hlo", "tests/lint_fixtures/bad_hlo_memory_infeasible.py")
+        assert bad.returncode == 1, bad.stdout + bad.stderr
+        assert "hlo-memory-infeasible" in bad.stdout
+
+        ok = self.run_cli("--hlo", "tests/lint_fixtures/suppressed_hlo_ok.py")
+        assert ok.returncode == 0, ok.stdout + ok.stderr
+        assert "0 HLO finding(s)" in ok.stdout
+
+    def test_lm_clean_and_manifest_agrees(self, tmp_path):
+        """The lm workload with the ZeRO knob on lints clean, and its live
+        signature matches the committed docs/hlo-manifest.json entry."""
+        json_path = tmp_path / "findings.json"
+        result = self.run_cli("--hlo", "lm", "--json", str(json_path))
+        assert result.returncode == 0, result.stdout + result.stderr
+        findings = json.loads(json_path.read_text())
+        assert findings["findings"] == []
+
+        committed = json.loads(
+            open(os.path.join(REPO, "docs", "hlo-manifest.json")).read())
+        manifest_path = tmp_path / "manifest.json"
+        regen = self.run_cli(
+            "--hlo", "lm", "--manifest", "--json", str(manifest_path))
+        assert regen.returncode == 0, regen.stdout + regen.stderr
+        live = json.loads(manifest_path.read_text())
+        assert live["workloads"]["lm"] == committed["workloads"]["lm"]
+
+    def test_stamped_plan_doc_agrees_with_compiled_hlo(self):
+        """e2e pin: the status.zeroShardingPlan doc the controller stamps
+        and the collective set extracted from the compiled lm program —
+        driven by the env knob on virtual devices — must agree.  Plan/HLO
+        drift becomes a test failure here, not a latent lie in status."""
+        from tf_operator_tpu.api.types import (
+            ReplicaType, TPUTopology, zero_sharding_plan_doc)
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from testutil import new_tpujob
+
+        job = new_tpujob(worker=2)
+        job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+            topology="2x2", mesh={"dp": 4}, zero_shard_weight_update=True)
+        doc = zero_sharding_plan_doc(job.spec)
+        assert doc == {"axis": "dp", "numShards": 4,
+                       "replicaType": ReplicaType.WORKER.value}
+
+        probe = (
+            "import json\n"
+            "from tf_operator_tpu.workloads.runner import WorkloadContext\n"
+            "from tf_operator_tpu.analysis import hlo\n"
+            "ctx = WorkloadContext.from_env()\n"
+            "assert ctx.zero_shard_weight_update\n"
+            "cap = hlo.capture_workload('lm', num_devices=%d,"
+            " zero=ctx.zero_shard_weight_update)\n"
+            "print(json.dumps({\n"
+            "  'axis': cap.plan.axis,\n"
+            "  'numShards': cap.plan.num_shards,\n"
+            "  'shardedEntries': len(cap.update_pairs),\n"
+            "  'collectives': hlo.collective_signature(cap.program),\n"
+            "  'findings': [f.rule for f in hlo.check_capture(cap)],\n"
+            "}))\n" % doc["numShards"])
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+        env["TPUJOB_ZERO_SHARD_WEIGHT_UPDATE"] = "1"
+        result = subprocess.run(
+            [sys.executable, "-c", probe], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=600)
+        assert result.returncode == 0, result.stdout + result.stderr
+        out = json.loads(result.stdout.splitlines()[-1])
+
+        # the doc's strategy matches the plan the runtime actually built
+        assert out["axis"] == doc["axis"]
+        assert out["numShards"] == doc["numShards"]
+        # ... and the compiled program implements it: the rules are clean,
+        # a weight-update all-gather exists for the sharded entries, the
+        # gradient reduction is present, all over numShards-wide groups
+        assert out["findings"] == []
+        assert out["shardedEntries"] > 0
+        gathers = out["collectives"]["all-gather"]
+        assert gathers["count"] >= out["shardedEntries"]
+        assert gathers["groupSizes"] == [doc["numShards"]]
+        assert out["collectives"]["all-reduce"]["count"] > 0

@@ -238,3 +238,55 @@ def test_zero_sharding_plan_stamped_and_cleared():
     stored.spec.replica_specs[ReplicaType.WORKER].tpu.zero_shard_weight_update = False
     cluster.update_job(stored)
     assert sync_until(controller, job.key(), lambda: plan() is None)
+
+
+def test_memory_infeasible_layout_rejected_at_admission():
+    """A declared layout whose params+grads+moments lower bound cannot fit
+    tpu.deviceMemoryGB fails at submit with its own validation reason
+    (MemoryInfeasible), before any pod exists to OOM — the admission wiring
+    of the HLO memory model (analysis/hlo.py, ROADMAP item 2)."""
+    from tf_operator_tpu.api.types import TPUTopology
+
+    controller, cluster, fake_pods, _ = new_controller()
+    job = new_tpujob(worker=2)
+    # 1B params dense on dp=8: 4 (params) + 4 (grads) + 8 (AdamW moments)
+    # bytes/param ~= 16 GB/device against a declared 8 GiB budget.
+    job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+        topology="2x4", mesh={"dp": 8}, zero_shard_weight_update=False,
+        device_memory_gb=8.0, model_params=1_000_000_000,
+    )
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    stored = cluster.get_job("default", "test-tpujob")
+    assert conditions.is_failed(stored.status)
+    failed = conditions.get_condition(stored.status, JobConditionType.FAILED)
+    assert failed.reason == "MemoryInfeasible"
+    assert "rejected at admission" in failed.message
+    # distinct from generic validation: the reason names the memory model
+    assert failed.reason != "FailedValidation"
+    assert fake_pods.pods == []  # rejected before any pod was created
+    events = [e for e in cluster.list_events()
+              if e.reason == "MemoryInfeasible"]
+    assert events, "admission rejection must surface as a Warning event"
+
+
+def test_memory_feasible_with_zero_sharding_admitted():
+    """The same model size is admitted once the ZeRO knob shards the
+    optimizer moments over dp — the admission check honors the declared
+    sharding strategy, so the knob is the fix the rejection message
+    suggests."""
+    from tf_operator_tpu.api.types import TPUTopology
+
+    controller, cluster, fake_pods, _ = new_controller()
+    job = new_tpujob(worker=2)
+    # ZeRO over dp=8: 4 + 4 + 8/8 bytes/param ~= 9 GB < 10 GiB budget,
+    # where the dense layout above needed ~16 GB.
+    job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+        topology="2x4", mesh={"dp": 8}, zero_shard_weight_update=True,
+        device_memory_gb=10.0, model_params=1_000_000_000,
+    )
+    cluster.create_job(job)
+    controller.sync_job(job.key())
+    stored = cluster.get_job("default", "test-tpujob")
+    assert not conditions.is_failed(stored.status)
+    assert fake_pods.pods  # pods proceed: the layout fits

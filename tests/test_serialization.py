@@ -241,7 +241,9 @@ def _maximal_job():
         restart_policy=RestartPolicy.EXIT_CODE,
         tpu=TPUTopology(accelerator="v5litepod-8", topology="2x4",
                         mesh={"dp": 2, "tp": 4},
-                        zero_shard_weight_update=True),
+                        zero_shard_weight_update=True,
+                        device_memory_gb=15.75,
+                        model_params=124_000_000),
         elastic=ElasticPolicy(min_replicas=2, max_replicas=4),
     )
     spec = TPUJobSpec(

@@ -845,7 +845,7 @@ def test_rule_doc_and_severity_metadata():
     """Every rule id resolves to a docs anchor; dynamic (race/explore-*)
     findings share the race-detector section.  Advisory rules are
     warnings, everything else an error."""
-    assert len(analysis.ALL_RULES) == 16  # 15 rules + parse-error
+    assert len(analysis.ALL_RULES) == 20  # 15 source + 4 hlo + parse-error
     for rule in (analysis.RULE_STATUSWRITER_BYPASS,
                  analysis.RULE_OWNERSHIP_FENCE,
                  analysis.RULE_STATE_MACHINE,

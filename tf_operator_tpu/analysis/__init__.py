@@ -109,6 +109,13 @@ from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from . import contract, lockgraph
+from .hlo import (
+    HLO_RULES,
+    RULE_HLO_MEMORY_INFEASIBLE,
+    RULE_HLO_PLAN_DRIFT,
+    RULE_HLO_REPLICATED_OPTSTATE,
+    RULE_HLO_SYNC_COLLECTIVE,
+)
 from .lockgraph import (
     RULE_ATOMICITY,
     RULE_GUARDED_INTERPROC,
@@ -151,6 +158,12 @@ ALL_RULES = (
     RULE_WIRE_ROUNDTRIP,
     RULE_KNOB_CHAIN,
     RULE_METRIC_DOC,
+    # compiled-program rules (analysis/hlo.py): fired by `--hlo`, never by
+    # the per-file static pass — they need a lowered+compiled train step
+    RULE_HLO_PLAN_DRIFT,
+    RULE_HLO_REPLICATED_OPTSTATE,
+    RULE_HLO_SYNC_COLLECTIVE,
+    RULE_HLO_MEMORY_INFEASIBLE,
     RULE_PARSE_ERROR,
 )
 
@@ -183,9 +196,12 @@ RULE_SEVERITY = {
 
 def rule_doc(rule: str) -> str:
     """URL-ish anchor into docs/static-analysis.md for a rule id.  The
-    dynamic explorer kinds (`race`, `explore-*`) share one section."""
+    dynamic explorer kinds (`race`, `explore-*`) share one section, as do
+    the compiled-program rules (`hlo-*`)."""
     if rule == RULE_RACE or rule.startswith("explore-"):
         return "docs/static-analysis.md#the-race-detector"
+    if rule in HLO_RULES:
+        return "docs/static-analysis.md#hlo-rules"
     return f"docs/static-analysis.md#{rule}"
 
 
@@ -215,7 +231,8 @@ CONDITION_STATE_MACHINES = {
     },
     "FAILED": {  # terminal
         "set": {"TPUJobFailed", "FailedValidation",
-                "BackoffLimitExceeded", "DeadlineExceeded"},
+                "BackoffLimitExceeded", "DeadlineExceeded",
+                "MemoryInfeasible"},
         "clear": set(),
     },
     "STUCK": {
@@ -1296,6 +1313,18 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="with --manifest: compare the regenerated "
                              "manifest against the committed snapshot at "
                              "PATH and exit 1 on drift")
+    parser.add_argument("--hlo", default=None, metavar="TARGET",
+                        help="compiled-program lint: capture+check the "
+                             "train-step HLO for a workload name, 'all', "
+                             "or a capture-fixture .py path (docs/"
+                             "static-analysis.md#hlo-rules). --json writes "
+                             "findings; --manifest --json PATH writes the "
+                             "collective-signature manifest; --diff PATH "
+                             "gates against the committed "
+                             "docs/hlo-manifest.json")
+    parser.add_argument("--devices", type=int, default=None,
+                        help="CPU virtual devices for --hlo capture "
+                             "(default: $ANALYSIS_HLO_DEVICES, else 4)")
     parser.add_argument("--race", default=None, metavar="SCENARIO",
                         help="instead of the static lint, run the "
                              "race-checked interleaving soak over one "
@@ -1307,8 +1336,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--seed", type=int, default=0,
                         help="base seed for --race schedules (default: 0)")
     args = parser.parse_args(argv)
-    if args.diff is not None and not args.manifest:
-        parser.error("--diff requires --manifest")
+    if args.diff is not None and not args.manifest and args.hlo is None:
+        parser.error("--diff requires --manifest or --hlo")
+
+    if args.hlo is not None:
+        from . import hlo as hlo_mod
+
+        wanted_hlo: Optional[Set[str]] = None
+        if args.rules is not None:
+            wanted_hlo = {r for r in args.rules.split(",") if r}
+            unknown = wanted_hlo - set(ALL_RULES)
+            if unknown:
+                raise SystemExit(
+                    f"unknown rule(s): {', '.join(sorted(unknown))}")
+        if args.manifest and args.json is None:
+            parser.error("--hlo --manifest requires --json PATH (the "
+                         "manifest output file)")
+        return hlo_mod.run_hlo(
+            args.hlo,
+            num_devices=args.devices,
+            json_path=None if args.manifest else args.json,
+            manifest_path=args.json if args.manifest else None,
+            diff_path=args.diff,
+            rules=wanted_hlo,
+        )
 
     if args.manifest:
         root, _prefix = resolve_package_dir(args.package)

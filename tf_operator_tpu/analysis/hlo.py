@@ -1,0 +1,997 @@
+"""Compiled-program analysis of the train path: the HLO lint layer.
+
+Every other pass in this package lints control-plane *Python*; this one
+inspects the artifact that actually runs on the accelerator — the XLA
+program the train step compiles to.  "Automatic Cross-Replica Sharding of
+Weight Update" (arXiv:2004.13336) only pays off when the compiler emits
+the right collectives (per-shard gradient reduction, one weight-update
+all-gather per sharded bucket, no replicated optimizer math), and AMP-style
+admission (arXiv:2210.07297) needs a per-device memory model it can trust.
+Both are properties of the compiled HLO, not of the source.
+
+The pipeline:
+
+  capture   lower+compile the real train step for a workload on CPU
+            virtual devices (XLA_FLAGS=--xla_force_host_platform_device_
+            count=N) — shapes come from jax.eval_shape exactly like
+            workloads/runner.zero_plan_for_workload, so no training, no
+            real init, deterministic output;
+  parse     the SPMD module text into a structured model: a collective
+            inventory (kind, shapes, byte counts, replica groups,
+            sync-vs-async start/done pairing) plus the ENTRY parameter
+            shapes (the per-device resident layout of the donated train
+            state) and XLA's own buffer-assignment memory stats;
+  check     four rules against the job's ZeroShardingPlan (train/zero.py)
+            — see docs/static-analysis.md#hlo-rules;
+  snapshot  a per-workload collective signature, committed as
+            docs/hlo-manifest.json and diff-gated in CI exactly like the
+            interface manifest (docs/static-analysis.md#hlo-manifest).
+
+Portability note baked into the rules: XLA's CPU backend legalizes
+reduce-scatter as all-reduce + slice and runs every collective
+synchronously, so `hlo-plan-drift` accepts either reduction form and
+`hlo-sync-collective` only fires for plan entries explicitly marked
+overlappable (PlanEntry.overlap — ROADMAP item 4a's contract).
+
+This module keeps its import surface stdlib-only; jax is imported lazily
+inside the capture functions, after _ensure_virtual_devices has had a
+chance to set the platform env (which must precede the first jax import).
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+RULE_HLO_PLAN_DRIFT = "hlo-plan-drift"
+RULE_HLO_REPLICATED_OPTSTATE = "hlo-replicated-optstate"
+RULE_HLO_SYNC_COLLECTIVE = "hlo-sync-collective"
+RULE_HLO_MEMORY_INFEASIBLE = "hlo-memory-infeasible"
+
+HLO_RULES = (
+    RULE_HLO_PLAN_DRIFT,
+    RULE_HLO_REPLICATED_OPTSTATE,
+    RULE_HLO_SYNC_COLLECTIVE,
+    RULE_HLO_MEMORY_INFEASIBLE,
+)
+
+HLO_MANIFEST_VERSION = 1
+HLO_MANIFEST_SCHEMA = "tf-operator-tpu/hlo-manifest"
+
+# The four train-path workloads the lint tier captures (--hlo all).
+TRAIN_WORKLOADS = ("lm", "resnet", "bert", "vit")
+
+DEFAULT_DEVICES = 4
+
+COLLECTIVE_KINDS = (
+    "all-reduce", "reduce-scatter", "all-gather", "collective-permute",
+    "all-to-all",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+_NP_TO_HLO = {
+    "bool": "pred", "int8": "s8", "uint8": "u8", "int16": "s16",
+    "uint16": "u16", "float16": "f16", "bfloat16": "bf16", "int32": "s32",
+    "uint32": "u32", "float32": "f32", "int64": "s64", "uint64": "u64",
+    "float64": "f64",
+}
+
+
+# ---------------------------------------------------------------------------
+# HLO text parsing
+
+# dtype[dims] with an optional layout suffix: f32[256,64]{1,0}, s32[]
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+# %name = <result shapes> <kind>[-start|-done](<operands>), attrs...
+_COLLECTIVE_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?(?P<name>[\w.\-]+)\s+=\s+(?P<result>.+?)\s+"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")"
+    r"(?P<flavor>-start|-done)?\(",
+)
+_IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[\d+\]")
+_EXPLICIT_GROUPS_RE = re.compile(r"replica_groups=\{(\{[\d,]*\}(?:,\{[\d,]*\})*)\}")
+_OP_NAME_RE = re.compile(r'metadata=\{[^}]*op_name="([^"]*)"')
+_ENTRY_RE = re.compile(r"^ENTRY [^(]*\((?P<params>.*)\)\s*->")
+
+Shape = Tuple[str, Tuple[int, ...]]  # (hlo dtype, dims)
+
+
+def shape_bytes(shape: Shape) -> int:
+    n = 1
+    for d in shape[1]:
+        n *= d
+    return n * _DTYPE_BYTES.get(shape[0], 4)
+
+
+def _parse_shapes(text: str) -> Tuple[Shape, ...]:
+    return tuple(
+        (m.group(1), tuple(int(d) for d in m.group(2).split(",") if d))
+        for m in _SHAPE_RE.finditer(text)
+        if m.group(1) in _DTYPE_BYTES
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveOp:
+    """One collective instruction of the compiled (per-device SPMD) module."""
+
+    kind: str                 # all-reduce | reduce-scatter | all-gather | ...
+    name: str                 # instruction name, e.g. all-gather.36
+    result_shapes: Tuple[Shape, ...]
+    operand_shapes: Tuple[Shape, ...]
+    bytes_moved: int          # result payload bytes (per device)
+    num_groups: int           # replica groups participating
+    group_size: int           # devices per group
+    asynchronous: bool        # emitted as a -start/-done pair
+    op_name: str = ""         # XLA metadata op_name (source attribution)
+
+
+@dataclasses.dataclass(frozen=True)
+class MemoryStats:
+    """XLA buffer-assignment sizes (jax Compiled.memory_analysis)."""
+
+    argument_bytes: int
+    output_bytes: int
+    alias_bytes: int   # outputs aliased onto (donated) arguments
+    temp_bytes: int
+
+    @property
+    def peak_bytes(self) -> int:
+        """Per-device resident estimate at the peak of one step: live
+        arguments + temporaries + any un-aliased output buffers."""
+        return (self.argument_bytes + self.temp_bytes
+                + max(0, self.output_bytes - self.alias_bytes))
+
+
+@dataclasses.dataclass(frozen=True)
+class HloProgram:
+    collectives: Tuple[CollectiveOp, ...]
+    entry_params: Tuple[Shape, ...]  # per-device ENTRY parameter shapes
+    unpaired_starts: int             # -start ops without a matching -done
+
+    def by_kind(self, kind: str) -> Tuple[CollectiveOp, ...]:
+        return tuple(op for op in self.collectives if op.kind == kind)
+
+
+def parse_hlo(text: str) -> HloProgram:
+    """Parse a compiled module's text dump into the structured model."""
+    collectives: List[CollectiveOp] = []
+    starts: Dict[str, int] = {}
+    dones = 0
+    entry_params: Tuple[Shape, ...] = ()
+    for line in text.splitlines():
+        entry = _ENTRY_RE.match(line)
+        if entry:
+            entry_params = _parse_shapes(entry.group("params"))
+            continue
+        match = _COLLECTIVE_RE.match(line)
+        if not match:
+            continue
+        flavor = match.group("flavor") or ""
+        kind = match.group("kind")
+        if flavor == "-done":
+            dones += 1
+            starts[kind] = starts.get(kind, 0) - 1
+            continue
+        if flavor == "-start":
+            starts[kind] = starts.get(kind, 0) + 1
+        results = _parse_shapes(match.group("result"))
+        operand_text = line[match.end():].split(")", 1)[0]
+        operands = _parse_shapes(operand_text)
+        if flavor == "-start":
+            # a start op's result tuple repeats the operands (the in-flight
+            # aliased buffers) before the actual results — drop that echo
+            if len(results) >= 2 * len(operands):
+                results = results[len(operands):]
+        num_groups, group_size = 1, 0
+        iota = _IOTA_GROUPS_RE.search(line)
+        explicit = _EXPLICIT_GROUPS_RE.search(line)
+        if iota:
+            num_groups, group_size = int(iota.group(1)), int(iota.group(2))
+        elif explicit:
+            groups = explicit.group(1)[1:-1].split("},{")
+            num_groups = len(groups)
+            group_size = max(
+                len([x for x in g.split(",") if x]) for g in groups)
+        op_name_m = _OP_NAME_RE.search(line)
+        collectives.append(CollectiveOp(
+            kind=kind,
+            name=match.group("name"),
+            result_shapes=results,
+            operand_shapes=operands,
+            bytes_moved=sum(shape_bytes(s) for s in results),
+            num_groups=num_groups,
+            group_size=group_size,
+            asynchronous=flavor == "-start",
+            op_name=op_name_m.group(1) if op_name_m else "",
+        ))
+    unpaired = sum(n for n in starts.values() if n > 0)
+    return HloProgram(
+        collectives=tuple(collectives),
+        entry_params=entry_params,
+        unpaired_starts=unpaired,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Capture: lower + compile the train step on CPU virtual devices
+
+
+def _ensure_virtual_devices(num_devices: int) -> None:
+    """Arrange for `num_devices` CPU devices.  Must win the race with the
+    first jax import — the CLI path calls this before any jax-touching
+    work; in-process callers that already initialized jax must have
+    enough devices or the capture refuses (it can't re-init the backend).
+    """
+    if "jax" in sys.modules:
+        import jax
+
+        if jax.device_count() < num_devices:
+            raise RuntimeError(
+                f"HLO capture needs {num_devices} devices but jax is "
+                f"already initialized with {jax.device_count()}; run via "
+                "`python -m tf_operator_tpu.analysis --hlo ...` (which "
+                "sets XLA_FLAGS before jax loads) or set "
+                "XLA_FLAGS=--xla_force_host_platform_device_count="
+                f"{num_devices}")
+        return
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={num_devices}"
+        ).strip()
+
+
+def _hlo_dtype(dtype) -> str:
+    import numpy as np
+
+    return _NP_TO_HLO.get(np.dtype(dtype).name, str(dtype))
+
+
+def _shard_dims(sharding, aval) -> Tuple[int, ...]:
+    shape = getattr(aval, "shape", ())
+    if sharding is None or not shape:
+        return tuple(shape)
+    return tuple(sharding.shard_shape(tuple(shape)))
+
+
+def expected_entry_shapes(shape_tree, sharding_tree) -> Tuple[Shape, ...]:
+    """The per-device ENTRY parameter shapes jit must produce for this
+    (abstract value, sharding) tree: each leaf's global shape cut down by
+    its NamedSharding.  The replicated-optstate rule compares this
+    expectation against the parsed ENTRY signature."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(shape_tree)
+    shardings = jax.tree_util.tree_leaves(sharding_tree)
+    assert len(leaves) == len(shardings), (len(leaves), len(shardings))
+    return tuple(
+        (_hlo_dtype(leaf.dtype), _shard_dims(sh, leaf))
+        for leaf, sh in zip(leaves, shardings)
+    )
+
+
+class _Box:
+    """Opaque (non-pytree) wrapper so plan entries survive tree_leaves."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanPair:
+    """One sharded plan entry's weight-update transfer: the compiled
+    program must gather `shard_dims` back to `base_dims` each step."""
+
+    shard_dims: Tuple[int, ...]
+    base_dims: Tuple[int, ...]
+    overlap: bool
+
+
+def plan_update_pairs(plan, param_shapes, base_shardings) -> Tuple[PlanPair, ...]:
+    """Per dim-sharded plan entry, the (shard shape -> base-local shape)
+    all-gather the ZeRO weight update implies (zero.constrain_to_base)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..train import zero as zero_lib
+
+    if plan is None:
+        return ()
+    ent_tree = zero_lib._map_with_plan(
+        param_shapes, plan, lambda leaf, e: _Box(e))
+    entries = [b.value for b in jax.tree_util.tree_leaves(ent_tree)]
+    leaves = jax.tree_util.tree_leaves(param_shapes)
+    bases = jax.tree_util.tree_leaves(base_shardings)
+    pairs = []
+    for leaf, base, entry in zip(leaves, bases, entries):
+        if entry is None or entry.dim is None:
+            continue
+        shard = NamedSharding(plan.mesh, entry.spec)
+        pairs.append(PlanPair(
+            shard_dims=_shard_dims(shard, leaf),
+            base_dims=_shard_dims(base, leaf),
+            overlap=bool(entry.overlap),
+        ))
+    return tuple(pairs)
+
+
+@dataclasses.dataclass
+class HloCapture:
+    """Everything the rules and the manifest need about one compiled
+    train-step program."""
+
+    workload: str
+    num_devices: int
+    zero: bool
+    plan: Any                                  # ZeroShardingPlan | None
+    program: HloProgram
+    memory: Optional[MemoryStats]
+    moments_per_param: int
+    expected_args: Tuple[Shape, ...]           # planned per-device layout
+    update_pairs: Tuple[PlanPair, ...]         # sharded-entry gathers due
+    opt_bytes_per_device: int                  # train/zero model estimate
+    params_bytes_per_device: int
+    anchor_file: str                           # abs path, for suppressions
+    anchor_path: str                           # display path for findings
+    anchor_line: int
+    device_memory_budget_bytes: int = 0        # 0 = no declared budget
+
+
+def capture_program(step_fn, args_shapes, in_shardings,
+                    donate_argnums=(0,)):
+    """Lower+compile `step_fn` at `args_shapes` under `in_shardings`;
+    return (HloProgram, MemoryStats|None).  The shared trunk for workload
+    capture, fixtures, and bench's per-arm signature hashing."""
+    import jax
+
+    compiled = jax.jit(
+        step_fn, donate_argnums=donate_argnums, in_shardings=in_shardings,
+    ).lower(*args_shapes).compile()
+    program = parse_hlo(compiled.as_text())
+    stats = compiled.memory_analysis()
+    memory = None
+    if stats is not None:
+        memory = MemoryStats(
+            argument_bytes=int(stats.argument_size_in_bytes),
+            output_bytes=int(stats.output_size_in_bytes),
+            alias_bytes=int(stats.alias_size_in_bytes),
+            temp_bytes=int(stats.temp_size_in_bytes),
+        )
+    return program, memory
+
+
+def _tree_bytes(shape_tree, sharding_tree=None) -> int:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(shape_tree)
+    shardings = (jax.tree_util.tree_leaves(sharding_tree)
+                 if sharding_tree is not None else [None] * len(leaves))
+    total = 0
+    for leaf, sh in zip(leaves, shardings):
+        dims = _shard_dims(sh, leaf)
+        n = 1
+        for d in dims:
+            n *= d
+        total += n * leaf.dtype.itemsize
+    return total
+
+
+# -- per-workload tiny-shape builders ---------------------------------------
+# Each returns the pieces of the real workload's construction chain
+# (workloads/<name>.py main()) at test-scale shapes: the model, the loss,
+# the optimizer factory, and the global batch.  Shapes stay tiny — capture
+# is about the *structure* of the compiled program, which is shape-
+# independent, not about realistic sizes.
+
+
+def _build_lm(mesh, num_devices):
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerConfig, TransformerLM
+    from ..train.optim import lm_optimizer
+    from ..train.step import lm_loss_fn
+
+    cfg = TransformerConfig(
+        vocab_size=128, num_layers=2, num_heads=2, d_model=32, d_ff=64,
+        max_len=16, mesh=mesh)
+    model = TransformerLM(cfg)
+    return dict(
+        model=model,
+        example=jnp.zeros((2, 16), jnp.int32),
+        loss_fn=lm_loss_fn(model.apply),
+        make_tx=lambda plan: lm_optimizer(
+            1e-3, schedule="constant", warmup_steps=0, total_steps=8,
+            zero_plan=plan, mesh=mesh if plan is not None else None),
+        batch={"tokens": ((2 * num_devices, 17), jnp.int32)},
+        moments_per_param=2,
+    )
+
+
+def _build_resnet(mesh, num_devices):
+    import jax.numpy as jnp
+    import optax
+
+    from ..models import resnet as resnet_lib
+    from ..train.step import classification_loss_fn
+
+    model = resnet_lib.ResNet18(num_classes=8)
+    return dict(
+        model=model,
+        example=jnp.zeros((2, 32, 32, 3), jnp.float32),
+        loss_fn=classification_loss_fn(
+            model.apply, has_batch_stats=True, model_kwargs={"train": True}),
+        make_tx=lambda plan: _zero_wrap(
+            optax.sgd(0.1, momentum=0.9), plan, mesh),
+        batch={"x": ((num_devices, 32, 32, 3), jnp.float32),
+               "label": ((num_devices,), jnp.int32)},
+        moments_per_param=1,       # SGD momentum keeps one moment
+        has_batch_stats=True,
+        init_kwargs={"train": True},
+    )
+
+
+def _build_bert(mesh, num_devices):
+    import jax.numpy as jnp
+    import optax
+
+    from ..models.transformer import BertEncoder, bert_base_config
+    from ..train.step import classification_loss_fn
+
+    cfg = bert_base_config(
+        num_layers=2, d_model=32, num_heads=2, d_ff=64, max_len=16,
+        mesh=mesh)
+    model = BertEncoder(cfg, num_labels=2)
+
+    def apply_logits(variables, tokens, **kw):
+        return model.apply(variables, tokens, **kw)["logits"]
+
+    return dict(
+        model=model,
+        example=jnp.zeros((2, 16), jnp.int32),
+        loss_fn=classification_loss_fn(apply_logits),
+        make_tx=lambda plan: _zero_wrap(optax.adamw(5e-5), plan, mesh),
+        batch={"x": ((num_devices, 16), jnp.int32),
+               "label": ((num_devices,), jnp.int32)},
+        moments_per_param=2,
+    )
+
+
+def _build_vit(mesh, num_devices):
+    import jax.numpy as jnp
+    import optax
+
+    from ..models.vit import ViT, vit_base_config
+    from ..train.step import classification_loss_fn
+
+    cfg = vit_base_config(
+        num_layers=2, num_heads=2, d_model=32, d_ff=128,
+        max_len=(16 // 8) ** 2 + 1, mesh=mesh)
+    model = ViT(cfg, num_classes=8, patch_size=8)
+    return dict(
+        model=model,
+        example=jnp.zeros((2, 16, 16, 3), jnp.float32),
+        loss_fn=classification_loss_fn(model.apply),
+        make_tx=lambda plan: _zero_wrap(optax.adamw(3e-4), plan, mesh),
+        batch={"x": ((num_devices, 16, 16, 3), jnp.float32),
+               "label": ((num_devices,), jnp.int32)},
+        moments_per_param=2,
+    )
+
+
+def _zero_wrap(tx, plan, mesh):
+    from ..train.zero import zero_shard_optimizer
+
+    return tx if plan is None else zero_shard_optimizer(tx, plan, mesh)
+
+
+_BUILDERS = {
+    "lm": _build_lm,
+    "resnet": _build_resnet,
+    "bert": _build_bert,
+    "vit": _build_vit,
+}
+
+
+def _workload_anchor(name: str) -> Tuple[str, str, int]:
+    """(abs file, display path, line of `def main`) for a builtin
+    workload — the source location findings anchor to, and where a
+    `# lint: allow(hlo-*)` suppression would live."""
+    from .. import workloads
+
+    path = os.path.join(
+        list(workloads.__path__)[0] if hasattr(workloads, "__path__")
+        else os.path.dirname(workloads.__file__), f"{name}.py")
+    line = 1
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for i, text in enumerate(fh, start=1):
+                if text.startswith("def main("):
+                    line = i
+                    break
+    except OSError:
+        pass
+    return path, f"workloads/{name}.py", line
+
+
+def capture_workload(name: str, num_devices: int = DEFAULT_DEVICES,
+                     zero: bool = True,
+                     overlap: bool = False,
+                     device_memory_budget_bytes: int = 0) -> HloCapture:
+    """Capture the compiled train step of a builtin workload on
+    `num_devices` CPU virtual devices over a {dp: N} mesh.
+
+    `zero` defaults ON — the lint tier's contract is "the four workloads
+    with the ZeRO knob on run clean"; callers driving the spec knob pass
+    WorkloadContext.zero_shard_weight_update through here (the env is
+    parsed in exactly one place, workloads/runner.py).  `overlap=True`
+    marks every sharded plan entry overlappable first (PlanEntry.overlap),
+    arming hlo-sync-collective.
+    """
+    if name not in _BUILDERS:
+        raise ValueError(
+            f"unknown workload {name!r} (expected one of {TRAIN_WORKLOADS})")
+    _ensure_virtual_devices(num_devices)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import batch_sharding, build_mesh
+    from ..parallel.tp_rules import make_param_shardings
+    from ..train import zero as zero_lib
+    from ..train.state import TrainState
+    from ..train.step import make_train_step
+
+    mesh = build_mesh({"dp": num_devices})
+    spec = _BUILDERS[name](mesh, num_devices)
+    model = spec["model"]
+    has_batch_stats = spec.get("has_batch_stats", False)
+    init_kwargs = spec.get("init_kwargs") or {}
+
+    # shapes via eval_shape — the zero_plan_for_workload path, no real init
+    import functools
+
+    variables = jax.eval_shape(
+        functools.partial(model.init, **init_kwargs),
+        jax.random.PRNGKey(0), spec["example"])
+    shapes = variables["params"]
+    batch_stats_shape = variables.get("batch_stats") if has_batch_stats else None
+    base = make_param_shardings(shapes, mesh)
+    plan = None
+    if zero:
+        plan = zero_lib.build_zero_plan(shapes, mesh, base_specs=base)
+        if overlap:
+            plan = plan.with_overlap()
+    tx = spec["make_tx"](plan)
+    opt_shape = jax.eval_shape(tx.init, shapes)
+
+    def opt_sharding_of(leaf, entry):
+        return NamedSharding(
+            mesh, entry.spec if entry is not None else P())
+
+    if plan is not None:
+        opt_sh = zero_lib._map_with_plan(opt_shape, plan, opt_sharding_of)
+    else:
+        opt_sh = jax.tree_util.tree_map(
+            lambda _: NamedSharding(mesh, P()), opt_shape)
+
+    def init_state(params):
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            opt_state=tx.init(params), batch_stats=batch_stats_shape,
+            apply_fn=model.apply, tx=tx, zero_plan=plan)
+
+    state_shape = jax.eval_shape(init_state, shapes)
+    replicate = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+        lambda _: NamedSharding(mesh, P()), tree)
+    state_sh = TrainState(
+        step=NamedSharding(mesh, P()), params=base, opt_state=opt_sh,
+        batch_stats=replicate(batch_stats_shape)
+        if batch_stats_shape is not None else None,
+        apply_fn=model.apply, tx=tx, zero_plan=plan)
+
+    batch_shape = {
+        key: jax.ShapeDtypeStruct(dims, dtype)
+        for key, (dims, dtype) in spec["batch"].items()
+    }
+    batch_sh = {key: batch_sharding(mesh) for key in batch_shape}
+
+    step = make_train_step(
+        spec["loss_fn"], has_batch_stats=has_batch_stats, jit=False)
+    program, memory = capture_program(
+        step, (state_shape, batch_shape), (state_sh, batch_sh))
+
+    anchor_file, anchor_path, anchor_line = _workload_anchor(name)
+    return HloCapture(
+        workload=name,
+        num_devices=num_devices,
+        zero=zero,
+        plan=plan,
+        program=program,
+        memory=memory,
+        moments_per_param=spec["moments_per_param"],
+        expected_args=(
+            expected_entry_shapes(state_shape, state_sh)
+            + expected_entry_shapes(batch_shape, batch_sh)),
+        update_pairs=plan_update_pairs(plan, shapes, base),
+        opt_bytes_per_device=zero_lib.opt_state_bytes_per_device(
+            plan, shapes, moments_per_param=spec["moments_per_param"]),
+        params_bytes_per_device=_tree_bytes(shapes, base),
+        anchor_file=anchor_file,
+        anchor_path=anchor_path,
+        anchor_line=anchor_line,
+        device_memory_budget_bytes=device_memory_budget_bytes,
+    )
+
+
+def capture_from_file(path: str, num_devices: int = DEFAULT_DEVICES):
+    """Load a capture-fixture module (tests/lint_fixtures/bad_hlo_*.py)
+    and run its `capture(num_devices)` entry point."""
+    import importlib.util
+
+    _ensure_virtual_devices(num_devices)
+    name = os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(f"_hlo_fixture_{name}", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    capture = module.capture(num_devices)
+    captures = capture if isinstance(capture, (list, tuple)) else [capture]
+    for cap in captures:
+        cap.anchor_file = os.path.abspath(path)
+        cap.anchor_path = os.path.relpath(path, os.getcwd())
+    return list(captures)
+
+
+# ---------------------------------------------------------------------------
+# The four rules
+
+
+def _multiset(items) -> Dict[Any, int]:
+    out: Dict[Any, int] = {}
+    for item in items:
+        out[item] = out.get(item, 0) + 1
+    return out
+
+
+def _gather_transfers(program: HloProgram, sync_only: bool = False):
+    """Multiset of (operand dims -> result dims) pairs served by the
+    program's all-gathers (tuple-combined gathers contribute pairwise)."""
+    pairs = []
+    for op in program.by_kind("all-gather"):
+        if sync_only and op.asynchronous:
+            continue
+        for operand, result in zip(op.operand_shapes, op.result_shapes):
+            pairs.append((operand[1], result[1]))
+    return _multiset(pairs)
+
+
+def check_capture(capture: HloCapture,
+                  rules: Optional[Sequence[str]] = None) -> List:
+    """Run the HLO rules against one capture.  Findings anchor at the
+    workload/fixture source (`anchor_path:anchor_line`), where the usual
+    `# lint: allow(<rule>)` suppression comment applies."""
+    from . import Finding, _Comments
+
+    try:
+        with open(capture.anchor_file, encoding="utf-8") as fh:
+            comments = _Comments(fh.read())
+    except OSError:
+        comments = _Comments("")
+    findings: List[Finding] = []
+
+    def emit(rule: str, message: str) -> None:
+        if rules is not None and rule not in rules:
+            return
+        if comments.allows(capture.anchor_line, rule):
+            return
+        findings.append(Finding(
+            rule=rule, path=capture.anchor_path.replace(os.sep, "/"),
+            line=capture.anchor_line, message=message))
+
+    program = capture.program
+
+    # hlo-plan-drift: every dim-sharded plan entry owes the compiled
+    # program one weight-update all-gather (shard shape -> base-local
+    # shape), and a plan with anything to reduce owes a gradient
+    # reduction (all-reduce, or reduce-scatter where the backend keeps
+    # it; XLA:CPU legalizes reduce-scatter to all-reduce + slice).
+    if capture.plan is not None and capture.update_pairs:
+        supply = _gather_transfers(program)
+        missing = []
+        for pair, count in _multiset(
+                (p.shard_dims, p.base_dims) for p in capture.update_pairs
+        ).items():
+            short = count - supply.get(pair, 0)
+            if short > 0:
+                missing.append((pair, short))
+        reductions = (len(program.by_kind("all-reduce"))
+                      + len(program.by_kind("reduce-scatter")))
+        problems = []
+        if missing:
+            total = sum(short for _, short in missing)
+            sample = ", ".join(
+                f"{list(pair[0])}->{list(pair[1])}x{short}"
+                for pair, short in missing[:3])
+            problems.append(
+                f"{total} of {len(capture.update_pairs)} sharded plan "
+                f"entries have no weight-update all-gather in the compiled "
+                f"program (missing {sample})")
+        if reductions == 0:
+            problems.append(
+                "no gradient reduction collective (all-reduce/"
+                "reduce-scatter) despite a data-parallel sharding plan")
+        if problems:
+            emit(RULE_HLO_PLAN_DRIFT,
+                 f"compiled HLO disagrees with the ZeroShardingPlan "
+                 f"(axis={capture.plan.axis!r}, "
+                 f"num_shards={capture.plan.num_shards}): "
+                 + "; ".join(problems))
+
+    # hlo-replicated-optstate: the donated train state must enter the
+    # program at its planned per-device layout — a moment buffer whose
+    # shard shape is absent from the ENTRY signature is materialized
+    # dense (the exact failure mode ZeRO exists to remove).
+    if capture.plan is not None and capture.expected_args:
+        measured = _multiset(program.entry_params)
+        missing = []
+        for shape, count in _multiset(capture.expected_args).items():
+            short = count - measured.get(shape, 0)
+            if short > 0:
+                missing.append((shape, short))
+        if missing:
+            sample = ", ".join(
+                f"{dtype}{list(dims)}x{short}"
+                for (dtype, dims), short in missing[:4])
+            emit(RULE_HLO_REPLICATED_OPTSTATE,
+                 f"{sum(s for _, s in missing)} expected per-device "
+                 f"shard buffer(s) missing from the compiled ENTRY "
+                 f"layout ({sample}) — optimizer state is materialized "
+                 f"at a larger (replicated) shape than the plan's")
+
+    # hlo-sync-collective: a plan entry marked overlappable whose
+    # weight-update gather compiled synchronously (no -start/-done pair)
+    # serializes the transfer the plan promised to hide.
+    overlap_pairs = [p for p in capture.update_pairs if p.overlap]
+    if overlap_pairs:
+        sync_supply = _gather_transfers(program, sync_only=True)
+        stuck = 0
+        for pair, count in _multiset(
+                (p.shard_dims, p.base_dims) for p in overlap_pairs).items():
+            stuck += min(count, sync_supply.get(pair, 0))
+        if stuck:
+            emit(RULE_HLO_SYNC_COLLECTIVE,
+                 f"{stuck} of {len(overlap_pairs)} overlappable plan "
+                 f"entries compiled to a synchronous all-gather "
+                 f"(no -start/-done pair) — the weight-update transfer "
+                 f"cannot overlap compute")
+
+    # hlo-memory-infeasible: the per-device peak estimate exceeds the
+    # declared device budget — this layout OOMs before step 2, so the
+    # reconciler rejects it at admission (reason MemoryInfeasible).
+    if capture.device_memory_budget_bytes > 0 and capture.memory is not None:
+        peak = capture.memory.peak_bytes
+        budget = capture.device_memory_budget_bytes
+        if peak > budget:
+            emit(RULE_HLO_MEMORY_INFEASIBLE,
+                 f"estimated per-device peak {peak} B exceeds the "
+                 f"declared device budget {budget} B "
+                 f"(args={capture.memory.argument_bytes} "
+                 f"temp={capture.memory.temp_bytes} "
+                 f"out={capture.memory.output_bytes} "
+                 f"aliased={capture.memory.alias_bytes}); "
+                 f"plan-model optimizer bytes/device="
+                 f"{capture.opt_bytes_per_device}")
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Collective signature + manifest (docs/hlo-manifest.json)
+
+
+def collective_signature(program: HloProgram) -> Dict[str, Any]:
+    """Aggregate the collective inventory by kind — the shape of the
+    program's communication, stable across renumbering."""
+    agg: Dict[str, Dict[str, Any]] = {}
+    for op in program.collectives:
+        entry = agg.setdefault(op.kind, {
+            "count": 0, "syncCount": 0, "totalBytes": 0, "groupSizes": set(),
+        })
+        entry["count"] += 1
+        entry["syncCount"] += 0 if op.asynchronous else 1
+        entry["totalBytes"] += op.bytes_moved
+        if op.group_size:
+            entry["groupSizes"].add(op.group_size)
+    return {
+        kind: {**entry, "groupSizes": sorted(entry["groupSizes"])}
+        for kind, entry in sorted(agg.items())
+    }
+
+
+def signature_hash(signature: Dict[str, Any]) -> str:
+    blob = json.dumps(signature, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
+
+
+def collective_signature_from_text(text: str) -> Tuple[Dict[str, Any], str]:
+    """(signature, hash) straight from a compiled module's text — the
+    bench.py per-arm hook."""
+    signature = collective_signature(parse_hlo(text))
+    return signature, signature_hash(signature)
+
+
+def workload_signature(capture: HloCapture) -> Dict[str, Any]:
+    signature: Dict[str, Any] = {
+        "collectives": collective_signature(capture.program),
+        "entryParameterBytes": sum(
+            shape_bytes(s) for s in capture.program.entry_params),
+        "optStateBytesPerDevice": capture.opt_bytes_per_device,
+        "paramsBytesPerDevice": capture.params_bytes_per_device,
+    }
+    if capture.memory is not None:
+        signature["peakBytesPerDevice"] = capture.memory.peak_bytes
+    if capture.plan is not None:
+        signature["plan"] = {
+            "axis": capture.plan.axis,
+            "numShards": capture.plan.num_shards,
+            "entries": len(capture.plan.entries),
+            "shardedEntries": len(capture.update_pairs),
+        }
+    return signature
+
+
+def build_manifest(captures: Sequence[HloCapture]) -> Dict[str, Any]:
+    workloads = {}
+    for capture in captures:
+        signature = workload_signature(capture)
+        workloads[capture.workload] = {
+            "hash": signature_hash(signature),
+            "signature": signature,
+        }
+    return {
+        "version": HLO_MANIFEST_VERSION,
+        "schema": HLO_MANIFEST_SCHEMA,
+        "numDevices": captures[0].num_devices if captures else 0,
+        "zeroShardWeightUpdate": bool(captures and captures[0].zero),
+        "workloads": workloads,
+    }
+
+
+def render_manifest(manifest: Dict[str, Any]) -> str:
+    return json.dumps(manifest, indent=2, sort_keys=True) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Admission-time memory feasibility (pure python — no jax, usable from the
+# reconciler without touching an accelerator backend)
+
+BYTES_PER_PARAM = 4          # f32 master weights
+BYTES_PER_MOMENT = 4         # moments kept in the param dtype
+
+
+def admission_peak_lower_bound(model_params: int, *, dp_shards: int = 1,
+                               model_parallel: int = 1, zero: bool = False,
+                               moments_per_param: int = 2) -> int:
+    """Analytic lower bound of the per-device training footprint for a
+    declared model size: params + grads (+ moments, ZeRO-divided when the
+    weight-update sharding knob is on).  Deliberately a LOWER bound — no
+    activations, no temps — so exceeding the budget here is a proof of
+    infeasibility, never a false positive.  The compiled-HLO measurement
+    (HloCapture.memory.peak_bytes) is the tight companion number; see
+    docs/roofline.md's training-memory table."""
+    model_parallel = max(1, model_parallel)
+    dp_shards = max(1, dp_shards)
+    params = model_params * BYTES_PER_PARAM // model_parallel
+    grads = model_params * BYTES_PER_PARAM // model_parallel
+    moments = (model_params * BYTES_PER_MOMENT * moments_per_param
+               // model_parallel)
+    if zero:
+        moments //= dp_shards
+    return params + grads + moments
+
+
+def admission_memory_check(tpu) -> Optional[str]:
+    """None when the declared layout can fit (or declares no budget);
+    otherwise the human-readable reason the reconciler attaches to its
+    MemoryInfeasible FAILED condition.  `tpu` is an api.types.TPUTopology
+    carrying device_memory_gb + model_params."""
+    if tpu is None or tpu.device_memory_gb <= 0 or tpu.model_params <= 0:
+        return None
+    mesh = dict(tpu.mesh or {})
+    dp_shards = int(mesh.get("dp", 1))
+    model_parallel = 1
+    for axis, size in mesh.items():
+        if axis != "dp":
+            model_parallel *= max(1, int(size))
+    need = admission_peak_lower_bound(
+        int(tpu.model_params), dp_shards=dp_shards,
+        model_parallel=model_parallel,
+        zero=bool(tpu.zero_shard_weight_update))
+    budget = int(tpu.device_memory_gb * (1024 ** 3))
+    if need <= budget:
+        return None
+    gib = need / (1024 ** 3)
+    hint = ("" if tpu.zero_shard_weight_update else
+            "; enabling tpu.zeroShardWeightUpdate would shard the "
+            "optimizer moments over dp")
+    return (f"model with {tpu.model_params} params needs >= {gib:.2f} GiB "
+            f"per device (params+grads+moments lower bound, mesh {mesh}) "
+            f"but tpu.deviceMemoryGB declares {tpu.device_memory_gb}"
+            f"{hint}")
+
+
+# ---------------------------------------------------------------------------
+# CLI driver (python -m tf_operator_tpu.analysis --hlo ...)
+
+
+def run_hlo(target: str, *, num_devices: Optional[int] = None,
+            json_path: Optional[str] = None,
+            manifest_path: Optional[str] = None,
+            diff_path: Optional[str] = None,
+            rules: Optional[Sequence[str]] = None) -> int:
+    """The `--hlo` mode: capture, lint, optionally snapshot/diff the
+    collective-signature manifest.  Returns the process exit code."""
+    from . import write_findings_json
+    from .contract import diff_summary
+
+    if num_devices is None:
+        num_devices = int(os.environ.get("ANALYSIS_HLO_DEVICES")
+                          or DEFAULT_DEVICES)
+    _ensure_virtual_devices(num_devices)
+    if target == "all":
+        names = list(TRAIN_WORKLOADS)
+    else:
+        names = [target]
+    captures: List[HloCapture] = []
+    for name in names:
+        if name.endswith(".py") or os.sep in name:
+            captures.extend(capture_from_file(name, num_devices))
+        else:
+            captures.append(capture_workload(name, num_devices))
+    findings = []
+    for capture in captures:
+        findings.extend(check_capture(capture, rules=rules))
+    for finding in findings:
+        print(finding.render())
+    print(f"{len(findings)} HLO finding(s) over {len(captures)} compiled "
+          f"train-step program(s) [{', '.join(c.workload for c in captures)}]")
+    if json_path:
+        write_findings_json(json_path, findings, f"hlo:{target}")
+        print(f"wrote {json_path}")
+    exit_code = 1 if findings else 0
+    manifest = build_manifest(captures)
+    if manifest_path:
+        with open(manifest_path, "w", encoding="utf-8") as fh:
+            fh.write(render_manifest(manifest))
+        print(f"wrote {manifest_path}")
+    if diff_path:
+        try:
+            with open(diff_path, encoding="utf-8") as fh:
+                committed = json.load(fh)
+        except (OSError, ValueError) as err:
+            print(f"cannot read committed HLO manifest {diff_path}: {err}")
+            return 1
+        drift = diff_summary(committed, manifest)
+        if drift:
+            print(f"HLO manifest drift vs {diff_path} "
+                  f"({len(drift)} difference(s)):")
+            for line in drift:
+                print(f"  {line}")
+            print("the compiled collective signature changed; if intended, "
+                  "regenerate with: python -m tf_operator_tpu.analysis "
+                  f"--hlo all --manifest --json {diff_path}")
+            exit_code = 1
+        else:
+            print(f"HLO manifest matches {diff_path}")
+    return exit_code

@@ -79,6 +79,8 @@ def _replica_to_dict(rs: ReplicaSpec) -> Dict[str, Any]:
             "topology": rs.tpu.topology,
             "mesh": dict(rs.tpu.mesh),
             "zeroShardWeightUpdate": rs.tpu.zero_shard_weight_update,
+            "deviceMemoryGB": rs.tpu.device_memory_gb,
+            "modelParams": rs.tpu.model_params,
         }
     if rs.elastic is not None:
         out["elastic"] = {
@@ -216,6 +218,8 @@ def _replica_from_dict(data: Dict[str, Any]) -> ReplicaSpec:
             zero_shard_weight_update=bool(
                 tpu_raw.get("zeroShardWeightUpdate", False)
             ),
+            device_memory_gb=float(tpu_raw.get("deviceMemoryGB", 0.0)),
+            model_params=int(tpu_raw.get("modelParams", 0)),
         )
     elastic_raw = data.get("elastic")
     elastic = None

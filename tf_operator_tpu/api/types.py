@@ -214,6 +214,18 @@ class TPUTopology:
     # Injected as TPUJOB_ZERO_SHARD_WEIGHT_UPDATE; the reconciler mirrors
     # the chosen strategy into status.zero_sharding_plan.
     zero_shard_weight_update: bool = False
+    # Declared per-device memory budget in GiB (0 = undeclared).  With
+    # model_params also declared, the reconciler rejects the job at
+    # admission when even the analytic lower bound of the training
+    # footprint — params + grads + optimizer moments under the declared
+    # sharding, the model analysis/hlo.py cross-checks against compiled
+    # HLO — cannot fit (reason MemoryInfeasible, docs/roofline.md).
+    device_memory_gb: float = 0.0
+    # Declared trainable-parameter count of the workload (0 = undeclared).
+    # The control plane never sees the param tree, so feasibility needs
+    # the submitter to state the model size; lying just moves the failure
+    # back to OOM time.
+    model_params: int = 0
 
     def num_chips(self) -> int:
         return topology_chips(self.topology) if self.topology else 0

@@ -173,3 +173,15 @@ def _validate_replica(rtype: ReplicaType, rspec) -> None:
                     f"TPUJobSpec is not valid: logical mesh {rspec.tpu.mesh} has "
                     f"{mesh_size} devices but topology {rspec.tpu.topology!r} has {chips} chips"
                 )
+
+    if rspec.tpu is not None:
+        if rspec.tpu.device_memory_gb < 0:
+            raise ValidationError(
+                f"TPUJobSpec is not valid: tpu.deviceMemoryGB for {rtype.value} "
+                f"must be >= 0, got {rspec.tpu.device_memory_gb}"
+            )
+        if rspec.tpu.model_params < 0:
+            raise ValidationError(
+                f"TPUJobSpec is not valid: tpu.modelParams for {rtype.value} "
+                f"must be >= 0, got {rspec.tpu.model_params}"
+            )

@@ -48,6 +48,7 @@ from ..api.types import (
     is_elastic,
     zero_sharding_plan_doc,
 )
+from ..analysis.hlo import admission_memory_check
 from ..utils import clock, locks
 from ..utils import logging as tpulog
 from ..utils import metrics
@@ -181,6 +182,19 @@ def _publish_virtual_replicas(
     metrics.virtual_replicas.labels("resizing").set(
         sum(r for _, r in snapshot)
     )
+
+
+def _memory_infeasibility(spec: TPUJobSpec) -> Optional[str]:
+    """First infeasible replica group's reason, or None.  Pure spec math
+    (analysis/hlo.admission_memory_check) — groups that declare no
+    tpu.deviceMemoryGB/modelParams budget are never rejected."""
+    for rspec in spec.replica_specs.values():
+        if rspec is None or rspec.tpu is None:
+            continue
+        reason = admission_memory_check(rspec.tpu)
+        if reason:
+            return reason
+    return None
 
 
 def gen_labels(job_name: str) -> Dict[str, str]:
@@ -384,10 +398,20 @@ class JobReconciler:
             result.wrote_status = self._write_status_if_changed(job, old_status)
             return result
 
-        # Job-level limits (ref: job.go:159-214).
+        # Job-level limits (ref: job.go:159-214).  Memory feasibility runs
+        # first: a layout whose analytic per-device lower bound (analysis/
+        # hlo.py, cross-checked against the compiled-HLO measurement) cannot
+        # fit the declared tpu.deviceMemoryGB budget is rejected at
+        # admission — before any pod exists to OOM (ROADMAP item 2).
         failure_reason = ""
         failure_message = ""
-        if self.past_backoff_limit(job, pods):
+        infeasible = _memory_infeasibility(job.spec)
+        if infeasible:
+            failure_reason = "MemoryInfeasible"
+            failure_message = (
+                f"TPUJob {job.metadata.name} rejected at admission: "
+                f"{infeasible}")
+        elif self.past_backoff_limit(job, pods):
             failure_reason = "BackoffLimitExceeded"
             failure_message = f"TPUJob {job.metadata.name} has failed because it has reached the specified backoff limit"
         elif self.past_active_deadline(job):

@@ -86,6 +86,13 @@ class PlanEntry:
     dim: Optional[int]  # dim the dp axis shards, None = replicated over dp
     base: P  # the param's own (tp/fsdp) layout
     spec: P  # base + dp axis on `dim` — the optimizer-state layout
+    # Bucketed-overlap intent (ROADMAP item 4a): True declares that this
+    # entry's weight-update collectives are expected to run asynchronously
+    # (start/done pairs overlapping compute).  Nothing sets it yet — the
+    # compiled-HLO lint (analysis/hlo.py, `hlo-sync-collective`) enforces
+    # it the day the overlap work lands, so the flag ships ahead of the
+    # scheduler change as a checked contract, not a comment.
+    overlap: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -123,6 +130,9 @@ class ZeroShardingPlan:
                         "shape": list(e.shape),
                         "dim": e.dim,
                         "base": _spec_to_json(e.base, len(e.shape)),
+                        # emitted only when set: older readers (and every
+                        # committed checkpoint) keep parsing unchanged
+                        **({"overlap": True} if e.overlap else {}),
                     }
                     for e in self.entries
                 ],
@@ -152,10 +162,24 @@ class ZeroShardingPlan:
                     dim=dim,
                     base=base,
                     spec=spec,
+                    overlap=bool(p.get("overlap", False)),
                 )
             )
         return cls(axis=axis, num_shards=num, entries=tuple(entries),
                    mesh=mesh)
+
+    def with_overlap(self) -> "ZeroShardingPlan":
+        """A copy whose sharded entries are marked overlappable — the
+        declaration the `hlo-sync-collective` rule (analysis/hlo.py)
+        enforces against the compiled program."""
+        return dataclasses.replace(
+            self,
+            entries=tuple(
+                dataclasses.replace(e, overlap=True) if e.dim is not None
+                else e
+                for e in self.entries
+            ),
+        )
 
 
 def match_param_suffix(
