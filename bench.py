@@ -68,7 +68,14 @@ default 4 — docs/zero-sharding.md).  BENCH_ELASTIC=1 adds the elastic
 resize arm (time-to-recover for a preemption -> dp/2 restore plus the
 goodput the shrunken mesh retains vs kill-and-restart's 0.0;
 BENCH_ELASTIC_DEVICES virtual devices on the CPU fallback, default 4 —
-docs/elasticity.md).
+docs/elasticity.md).  BENCH_SCHED_POLICY=1 adds the scheduling-policy
+soak (thousands of short preemptible gangs from two weighted tenants +
+a few pool-scale high-class gangs, with FaultRules and a mid-run
+replica kill, emitting p99 submit->all-Running per priority class and
+the Jain fairness index — docs/scheduling-policy.md; BENCH_SCHED_JOBS
+job count default 2000, BENCH_SCHED_WAVE arrival-wave size default 200,
+BENCH_SCHED_BIG high-class gangs default 3, BENCH_SCHED_CHIPS pool
+size default 64).
 """
 from __future__ import annotations
 
@@ -383,6 +390,30 @@ def _elastic_ab(stages, platform):
     return parsed if ok else None
 
 
+def _sched_policy(stages):
+    """Scheduling-policy soak (docs/scheduling-policy.md), env-gated
+    BENCH_SCHED_POLICY=1: thousands of short preemptible low/batch gangs
+    from two weighted tenants churn through the policy queue while a few
+    pool-scale high-class gangs preempt their way in, under injected
+    FaultRules and one mid-run controller-replica crash-kill.  Emits p99
+    submit->all-Running per priority class and the Jain fairness index of
+    the weighted tenant dominant shares.  Pure control plane — no jax."""
+    if os.environ.get("BENCH_SCHED_POLICY") != "1":
+        return None
+    t0 = time.time()
+    rc, out, err = _run(
+        [sys.executable, os.path.abspath(__file__), "--child-sched-policy"],
+        {"TPUJOB_FORCE_PLATFORM": "cpu"}, CHILD_TIMEOUT,
+    )
+    parsed = _last_json(out)
+    ok = parsed is not None and "error" not in (parsed or {})
+    stages.append({"stage": "sched_policy", "rc": rc,
+                   "sec": round(time.time() - t0, 1), "ok": ok,
+                   **({} if ok else
+                      {"err": (parsed or {}).get("error") or err[-300:]})})
+    return parsed if ok else None
+
+
 def _native(stages):
     if os.environ.get("BENCH_SKIP_NATIVE"):
         return None
@@ -460,7 +491,7 @@ def orchestrate() -> None:
         stages.append({"stage": "orchestrator", "err": repr(e)[:300]})
     if not attention_done:
         _run_attention()
-    cp = native = zero = elastic = None
+    cp = native = zero = elastic = sched = None
     try:
         zero = _zero_ab(stages, platform)
     except Exception as e:  # noqa: BLE001
@@ -469,6 +500,10 @@ def orchestrate() -> None:
         elastic = _elastic_ab(stages, platform)
     except Exception as e:  # noqa: BLE001
         stages.append({"stage": "elastic_ab", "err": repr(e)[:300]})
+    try:
+        sched = _sched_policy(stages)
+    except Exception as e:  # noqa: BLE001
+        stages.append({"stage": "sched_policy", "err": repr(e)[:300]})
     try:
         cp = _control_plane(stages)
     except Exception as e:  # noqa: BLE001
@@ -503,6 +538,8 @@ def orchestrate() -> None:
         headline["zero"] = zero
     if elastic:
         headline["elastic"] = elastic
+    if sched:
+        headline["sched_policy"] = sched
     headline["stages"] = stages
     print(json.dumps(_compact_summary(headline)))
 
@@ -1308,6 +1345,251 @@ def child_control_plane() -> None:
 
 
 # ---------------------------------------------------------------------------
+# Child: scheduling-policy soak (policy queue under mixed load + faults)
+# ---------------------------------------------------------------------------
+
+def child_sched_policy() -> None:
+    """Mixed-priority churn through the policy queue (pure control plane):
+    BENCH_SCHED_JOBS short preemptible low/batch single-worker gangs from
+    two weighted tenants arrive in waves against a pool sized for ~8 of
+    them, while BENCH_SCHED_BIG pool-scale high-class gangs drop in at
+    intervals — each must preempt or out-queue its way to fully-Running.
+    A seeded FaultPlan plus a scripted create-pod FaultRule runs the whole
+    time, and one of the two controller replicas is crash-killed (no lease
+    release) halfway through.  Emits p50/p99 submit->all-Running per
+    priority class, the Jain index of the weighted tenant dominant shares,
+    and the preemption count."""
+    import threading
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from testutil import new_tpujob
+
+    from tf_operator_tpu.api.core import PodPhase
+    from tf_operator_tpu.api.types import (
+        PRIORITY_CLASSES,
+        ReplicaType,
+        RestartPolicy,
+        SchedulingSpec,
+        TPUTopology,
+    )
+    from tf_operator_tpu.controller.controller import TPUJobController
+    from tf_operator_tpu.runtime import conditions
+    from tf_operator_tpu.runtime.cluster import InMemoryCluster
+    from tf_operator_tpu.runtime.faults import (
+        FAULT_SERVER_ERROR,
+        Fault,
+        FaultInjector,
+        FaultPlan,
+        FaultRule,
+        FaultyCluster,
+    )
+    from tf_operator_tpu.runtime.policy import jain_index
+    from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+    from tf_operator_tpu.runtime.scheduler import GangScheduler
+    from tf_operator_tpu.runtime.shardlease import ShardLeaseConfig
+    from tf_operator_tpu.utils import metrics
+
+    jobs_total = int(os.environ.get("BENCH_SCHED_JOBS", "2000"))
+    wave = int(os.environ.get("BENCH_SCHED_WAVE", "200"))
+    big_gangs = int(os.environ.get("BENCH_SCHED_BIG", "3"))
+    total_chips = int(os.environ.get("BENCH_SCHED_CHIPS", "64"))
+    weights = {"ten-a": 2.0, "ten-b": 1.0}
+
+    rules = [FaultRule(fault=Fault(FAULT_SERVER_ERROR, status=500,
+                                   message="bench-injected"),
+                       op="create_pod", path="short-", times=8)]
+    injector = FaultInjector(FaultPlan(seed=20260807, rate=0.02, rules=rules,
+                                       latency_range=(0.0, 0.002)))
+    inner = InMemoryCluster()
+    faulty = FaultyCluster(inner, injector)
+    scheduler = GangScheduler(inner, total_chips=total_chips,
+                              tenant_weights=weights)
+    # A shared scheduler must not be gated on one replica's shard split.
+    scheduler.owns_gang = lambda key: True
+    fleet = [
+        TPUJobController(
+            faulty,
+            config=ReconcilerConfig(enable_gang_scheduling=True,
+                                    reconciler_sync_loop_period=0.2),
+            threadiness=2,
+            shards=4,
+            shard_lease=ShardLeaseConfig(lease_duration=1.0,
+                                         renew_period=0.15),
+            identity=f"replica-{i}",
+        )
+        for i in range(2)
+    ]
+    for c in fleet:
+        c.gang_scheduler = scheduler
+
+    def short_job(i):
+        job = new_tpujob(worker=1, name=f"short-{i:05d}",
+                         restart_policy=RestartPolicy.EXIT_CODE)
+        job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+            accelerator="v5litepod", topology="2x4")  # 8 chips
+        job.spec.scheduling = SchedulingSpec(
+            priority_class=("low", "batch")[i % 2],
+            tenant=("ten-a", "ten-b")[i % 2],
+            preemptible=True,
+        )
+        return job
+
+    def big_job(i):
+        job = new_tpujob(worker=4, name=f"big-{i}",
+                         restart_policy=RestartPolicy.EXIT_CODE)
+        job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+            accelerator="v5litepod", topology="2x4")
+        job.spec.scheduling = SchedulingSpec(priority_class="high")
+        return job
+
+    stop = threading.Event()
+    state_lock = threading.Lock()
+    expected = {}   # name -> (replicas, priority_class), set at submission
+    t_submit = {}   # name -> wall-clock submit time
+    t_running = {}  # name -> wall-clock all-Running time (kubelet-stamped)
+    share_samples = {t: [] for t in weights}
+
+    def kubelet():
+        """Promote Pending pods; once a job's full gang is Running, stamp
+        its time-to-all-running and only THEN complete it — the stamp is
+        taken in the same sweep that observes the state, so a short job's
+        Running window can never be missed by a sampler race."""
+        while not stop.is_set():
+            by_job = {}
+            for pod in inner.list_pods():
+                by_job.setdefault(
+                    pod.metadata.labels.get("job-name"), []).append(pod)
+            with state_lock:
+                exp = dict(expected)
+            for name, plist in by_job.items():
+                info = exp.get(name)
+                if info is None:
+                    continue
+                for p in plist:
+                    if p.status.phase == PodPhase.PENDING:
+                        try:
+                            inner.set_pod_phase(
+                                "default", p.metadata.name, PodPhase.RUNNING)
+                        except Exception:  # noqa: BLE001 — deleted mid-sweep
+                            continue
+                running = [p for p in plist
+                           if p.status.phase == PodPhase.RUNNING]
+                with state_lock:
+                    stamped = name in t_running
+                    if not stamped and len(running) == info[0]:
+                        t_running[name] = time.time()
+                        stamped = True
+                if stamped:
+                    for p in running:
+                        try:
+                            inner.set_pod_phase(
+                                "default", p.metadata.name,
+                                PodPhase.SUCCEEDED, exit_code=0)
+                        except Exception:  # noqa: BLE001
+                            continue
+            for tenant in weights:
+                v = metrics.tenant_dominant_share.value(tenant)
+                if v:
+                    share_samples[tenant].append(v)
+            stop.wait(0.01)
+
+    def submit(job, replicas, cls):
+        with state_lock:
+            expected[job.metadata.name] = (replicas, cls)
+            t_submit[job.metadata.name] = time.time()
+        inner.create_job(job)
+
+    for c in fleet:
+        c.start()
+    kubelet_thread = threading.Thread(target=kubelet, daemon=True,
+                                      name="sched-policy-kubelet")
+    kubelet_thread.start()
+    try:
+        waves = max(1, (jobs_total + wave - 1) // wave)
+        big_at = {max(1, (w + 1) * waves // (big_gangs + 1))
+                  for w in range(big_gangs)} if big_gangs else set()
+        submitted = 0
+        killed = False
+        for w in range(waves):
+            for _ in range(min(wave, jobs_total - submitted)):
+                job = short_job(submitted)
+                submit(job, 1, job.spec.scheduling.priority_class)
+                submitted += 1
+            if w in big_at:
+                idx = sorted(big_at).index(w)
+                submit(big_job(idx), 4, "high")
+            if not killed and w >= waves // 2:
+                # mid-soak crash: no lease release, no graceful handoff
+                fleet[0].shard_manager.stop(release=False)
+                fleet[0].stop()
+                killed = True
+            # bound the backlog so the policy sweep cost stays realistic
+            # (an arrival process, not one 2000-deep instantaneous queue)
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                with state_lock:
+                    backlog = submitted - len(t_running)
+                if backlog < wave:
+                    break
+                time.sleep(0.05)
+        if not killed and len(fleet) > 1:
+            fleet[0].shard_manager.stop(release=False)
+            fleet[0].stop()
+
+        def all_done():
+            return all(conditions.is_succeeded(j.status)
+                       for j in inner.list_jobs())
+
+        deadline = time.time() + 300
+        while time.time() < deadline and not all_done():
+            time.sleep(0.2)
+        if not all_done():
+            stuck = [j.metadata.name for j in inner.list_jobs()
+                     if not conditions.is_succeeded(j.status)]
+            print(json.dumps({"error": f"{len(stuck)} jobs never finished",
+                              "stuck": stuck[:10]}))
+            return
+
+        classes = {}
+        unmeasured = 0
+        with state_lock:
+            for name, (_replicas, cls) in expected.items():
+                if name not in t_running:
+                    unmeasured += 1
+                    continue
+                classes.setdefault(cls, []).append(
+                    t_running[name] - t_submit[name])
+        per_class = {}
+        for cls, waits in classes.items():
+            waits.sort()
+            per_class[cls] = {
+                "n": len(waits),
+                "p50_s": round(waits[len(waits) // 2], 4),
+                "p99_s": round(waits[min(len(waits) - 1,
+                                         int(0.99 * len(waits)))], 4),
+            }
+        mean_shares = [sum(v) / len(v)
+                       for v in share_samples.values() if v]
+        preempted = sum(metrics.preemptions.value(c)
+                        for c in PRIORITY_CLASSES)
+        print(json.dumps({
+            "jobs": jobs_total,
+            "big_gangs": big_gangs,
+            "pool_chips": total_chips,
+            "classes": per_class,
+            "fairness_jain": round(jain_index(mean_shares), 4),
+            "preemptions": preempted,
+            "faults_injected": len(injector.trace),
+            "unmeasured": unmeasured,
+        }))
+    finally:
+        stop.set()
+        kubelet_thread.join(timeout=5)
+        for c in fleet[1:]:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
 # Child: control plane over the k8s wire (fake apiserver + kubelet sim)
 # ---------------------------------------------------------------------------
 
@@ -1621,6 +1903,8 @@ if __name__ == "__main__":
         child_control_plane()
     elif "--child-k8s-control-plane" in sys.argv:
         child_k8s_control_plane()
+    elif "--child-sched-policy" in sys.argv:
+        child_sched_policy()
     elif "--child-native" in sys.argv:
         child_native()
     else:

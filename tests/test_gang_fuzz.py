@@ -205,3 +205,180 @@ def test_gang_churn_fuzz(seed):
         "slices still held after every gang departed"
     )
     assert harness.scheduler.pool.used == 0
+
+
+# ---------------------------------------------------------------------------
+# scheduling-policy fuzz: randomized priority/tenant/preemptible mixes on top
+# of the same churn ops.  Adds the policy invariants from ISSUE 20:
+#
+#   E. strict priority is live: at a quiescent point, the head of the policy
+#      queue never waits while evicting preemptible strictly-lower-class
+#      admitted gangs would cover its shortfall
+#   F. preempted jobs always requeue: a job that ever carried the Preempted
+#      condition is never Failed
+#
+# (Deterministic fair-share convergence is pinned by
+# test_fair_share_converges_random_arrival below and by
+# test_gang_scheduler.py's weighted-share test.)
+
+from tf_operator_tpu.api.types import JobConditionType, SchedulingSpec
+from tf_operator_tpu.runtime import conditions, policy
+
+TENANT_WEIGHTS = {"ten-a": 2.0, "ten-b": 1.0}
+
+
+class PolicyFuzzHarness(FuzzHarness):
+    def __init__(self, seed: int, slices: int = 3):
+        super().__init__(seed, slices)
+        self.scheduler.tenant_weights = dict(TENANT_WEIGHTS)
+        self.preempted_ever = set()
+
+    def op_create(self):
+        if len(self.jobs) >= 4:
+            return
+        self.counter += 1
+        name = f"fz-{self.counter}"
+        workers = self.rng.choice([HOSTS, 2 * HOSTS])
+        job = sliced_job(name, workers)
+        job.spec.scheduling = SchedulingSpec(
+            priority_class=self.rng.choice(
+                ("low", "batch", "standard", "high", "critical")
+            ),
+            tenant=self.rng.choice(sorted(TENANT_WEIGHTS)),
+            preemptible=self.rng.random() < 0.5,
+        )
+        self.cluster.create_job(job)
+        self.jobs[name] = workers
+
+    def check_policy(self, step_no: int):
+        ctx = f"step {step_no}"
+        for name in sorted(self.jobs):
+            try:
+                job = self.cluster.get_job("default", name)
+            except NotFound:
+                continue
+            if conditions.has_condition(job.status, JobConditionType.PREEMPTED):
+                self.preempted_ever.add(name)
+            if name in self.preempted_ever:
+                assert not conditions.is_failed(job.status), (
+                    f"{ctx}: preempted job {name} Failed — preemption must "
+                    "requeue, never Fail"
+                )
+
+    def assert_head_not_starved(self):
+        """Invariant E, checked only at a quiescent point (no eviction in
+        flight, every job synced to a fixpoint)."""
+        s = self.scheduler
+        pods_by_key = {}
+        for pod in self.cluster.list_pods():
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            group = pod.metadata.annotations.get(
+                constants.GANG_GROUP_ANNOTATION
+            )
+            if group:
+                key = f"{pod.metadata.namespace}/{group}"
+                pods_by_key.setdefault(key, []).append(pod)
+        with s._lock:
+            admitted = set(s._admitted)
+            info = dict(s._policy_info)
+            assert not s._evicting, "eviction still in flight at fixpoint"
+        waiting = [
+            s._gang_request(key, pods)
+            for key, pods in sorted(pods_by_key.items())
+            if key not in admitted
+        ]
+        waiting = [r for r in waiting if not s._is_unsatisfiable(r)]
+        if not waiting:
+            return
+        usage = {}
+        for key in admitted:
+            req = info.get(key)
+            if req is not None:
+                usage[req.tenant] = usage.get(req.tenant, 0.0) + req.chips()
+        head = policy.policy_order(
+            waiting, usage, s.pool.total, s.tenant_weights
+        )[0]
+        missing = policy.shortfall(head.dims, s._free_dims((head,)))
+        if not missing:
+            return  # blocked on gang membership, not capacity
+        candidates = [info[k] for k in admitted if k in info]
+        victims = policy.select_victims(missing, head.rank, candidates)
+        assert not victims, (
+            f"gang {head.key} (class {head.policy.priority_class}) waits at "
+            f"fixpoint though evicting {[v.key for v in victims]} covers its "
+            f"shortfall {missing}"
+        )
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_policy_mix_fuzz(seed):
+    harness = PolicyFuzzHarness(seed)
+    for step_no in range(80):
+        harness.step()
+        harness.check(step_no)
+        harness.check_policy(step_no)
+    # settle to a fixpoint: repair the fabric, sync every job a few times so
+    # in-flight evictions drain and requeued victims re-enter the queue
+    for slc in harness.provider.list_slices():
+        if slc.state == SliceState.PREEMPTED:
+            harness.provider.repair(slc.id)
+    for _ in range(5):
+        harness.op_sync()
+    harness.check(999)
+    harness.check_policy(999)
+    harness.assert_head_not_starved()
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_fair_share_converges_random_arrival(seed):
+    """Same class, two tenants with weights 3:1, random arrival order, room
+    for four equal gangs: admission always lands 3 for the heavy tenant and
+    1 for the light one — dominant share tracks the weights, independent of
+    the interleaving of arrivals."""
+    rng = random.Random(seed)
+    cluster = InMemoryCluster()
+    controller = TPUJobController(
+        cluster, config=ReconcilerConfig(enable_gang_scheduling=True)
+    )
+    scheduler = GangScheduler(
+        cluster, total_chips=32, tenant_weights={"ten-a": 3.0, "ten-b": 1.0}
+    )
+
+    def chip_job(name, workers, tenant=None):
+        job = new_tpujob(worker=workers, name=name)
+        job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+            accelerator="v5litepod", topology="2x4"  # 8 chips/worker
+        )
+        if tenant is not None:
+            job.spec.scheduling = SchedulingSpec(tenant=tenant)
+        set_defaults(job)
+        return job
+
+    hold = chip_job("hold", workers=4)
+    cluster.create_job(hold)
+    controller.sync_job("default/hold")
+
+    arrivals = [(f"a{i}", "ten-a") for i in range(4)]
+    arrivals += [(f"b{i}", "ten-b") for i in range(4)]
+    rng.shuffle(arrivals)
+    for name, tenant in arrivals:
+        cluster.create_job(chip_job(name, 1, tenant))
+        controller.sync_job(f"default/{name}")
+
+    for pod in cluster.list_pods(selector={"job-name": "hold"}):
+        cluster.set_pod_phase(
+            "default", pod.metadata.name, PodPhase.SUCCEEDED, exit_code=0
+        )
+
+    def admitted_names():
+        out = set()
+        for pod in cluster.list_pods():
+            if pod.metadata.annotations.get("tpu-operator.dev/bound") == "true":
+                out.add(pod.metadata.labels.get("job-name"))
+        return out
+
+    names = admitted_names()
+    a = sum(1 for n in names if n and n.startswith("a"))
+    b = sum(1 for n in names if n and n.startswith("b"))
+    assert (a, b) == (3, 1), (arrivals, sorted(names))

@@ -129,3 +129,344 @@ def test_non_gang_pods_start_immediately():
     cluster2.create_job(job)
     controller2.sync_job(job.key())
     assert len(bound(cluster2, "test-tpujob")) == 2
+
+
+# ---------------------------------------------------------------------------
+# scheduling-policy layer (runtime/policy.py, docs/scheduling-policy.md)
+
+def sched_job(name, workers, chips_per_worker=8, priority="standard",
+              tenant="default", preemptible=False):
+    from tf_operator_tpu.api.types import SchedulingSpec
+
+    job = tpu_job(name, workers, chips_per_worker)
+    job.spec.scheduling = SchedulingSpec(
+        priority_class=priority, tenant=tenant, preemptible=preemptible
+    )
+    return job
+
+
+def finish(cluster, controller, job):
+    """Succeed every pod of `job` (departure releases the reservation)."""
+    for pod in cluster.list_pods(selector={"job-name": job.metadata.name}):
+        cluster.set_pod_phase(
+            "default", pod.metadata.name, PodPhase.SUCCEEDED, exit_code=0
+        )
+
+
+def test_waiting_gangs_admit_in_creation_order():
+    """Satellite regression: two waiting gangs admit FIFO by gang creation
+    timestamp, regardless of the order cluster.list_pods() returns them —
+    the old sweep admitted in pod-list scan order."""
+    cluster, controller, scheduler = make_stack(total_chips=32)
+    hold = tpu_job("hold", workers=4)
+    cluster.create_job(hold)
+    controller.sync_job(hold.key())
+    assert len(bound(cluster, "hold")) == 4
+
+    # "second" enters the pod list FIRST; "first" is then backdated to the
+    # older creation timestamp, so scan order and FIFO order disagree.
+    second = tpu_job("second", workers=4)
+    first = tpu_job("first", workers=4)
+    cluster.create_job(second)
+    controller.sync_job(second.key())
+    cluster.create_job(first)
+    controller.sync_job(first.key())
+    for pod in cluster.list_pods(selector={"job-name": "first"}):
+        pod.metadata.creation_timestamp -= 1000.0
+    assert bound(cluster, "first") == [] and bound(cluster, "second") == []
+
+    finish(cluster, controller, hold)  # frees exactly one gang's capacity
+    assert len(bound(cluster, "first")) == 4
+    assert bound(cluster, "second") == []
+
+    finish(cluster, controller, first)
+    assert len(bound(cluster, "second")) == 4
+
+
+def test_strict_priority_overtakes_fifo():
+    """A high-class gang admits before an earlier-created low-class gang."""
+    cluster, controller, scheduler = make_stack(total_chips=32)
+    hold = tpu_job("hold-p", workers=4)
+    cluster.create_job(hold)
+    controller.sync_job(hold.key())
+
+    lo = sched_job("lo-first", workers=4, priority="low")
+    cluster.create_job(lo)
+    controller.sync_job(lo.key())
+    hi = sched_job("hi-later", workers=4, priority="high")
+    cluster.create_job(hi)
+    controller.sync_job(hi.key())
+    assert bound(cluster, "lo-first") == [] and bound(cluster, "hi-later") == []
+
+    finish(cluster, controller, hold)
+    assert len(bound(cluster, "hi-later")) == 4
+    assert bound(cluster, "lo-first") == []
+
+
+def test_backfill_never_delays_blocked_higher_gang():
+    """A small low-class gang may NOT take capacity a blocked higher-class
+    gang needs (conservative backfill)..."""
+    cluster, controller, scheduler = make_stack(total_chips=40)
+    hold = tpu_job("bf-hold", workers=4)  # 32 chips -> 8 free
+    cluster.create_job(hold)
+    controller.sync_job(hold.key())
+    assert len(bound(cluster, "bf-hold")) == 4
+
+    hi = sched_job("bf-hi", workers=4, priority="high")  # wants 32: blocked
+    cluster.create_job(hi)
+    controller.sync_job(hi.key())
+    small = sched_job("bf-small", workers=1, priority="low")  # 8 chips: fits
+    cluster.create_job(small)
+    controller.sync_job(small.key())
+    # small fits the free 8 chips, but jumping would delay bf-hi's earliest
+    # feasible admission -> it queues behind.
+    assert bound(cluster, "bf-small") == []
+
+    finish(cluster, controller, hold)
+    # freed capacity goes to the blocked high gang first; the backfill
+    # candidate then takes the genuinely spare remainder.
+    assert len(bound(cluster, "bf-hi")) == 4
+    assert len(bound(cluster, "bf-small")) == 1
+
+
+def test_backfill_jumps_on_disjoint_dimensions():
+    """Backfill IS allowed when the candidate cannot touch any dimension the
+    blocked higher gang needs (slice shapes vs plain chips)."""
+    from tf_operator_tpu.controller.controller import TPUJobController
+    from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+    from tf_operator_tpu.runtime.slices import FakeSliceProvider
+
+    cluster = InMemoryCluster()
+    controller = TPUJobController(
+        cluster, config=ReconcilerConfig(enable_gang_scheduling=True)
+    )
+    # 4 workers on 2x4 (2 hosts/slice) need exactly the 2 slices we have.
+    provider = FakeSliceProvider({("v5litepod", "2x4"): 2})
+    scheduler = GangScheduler(cluster, slice_provider=provider)
+
+    hold = tpu_job("dj-hold", workers=4)  # takes both 2x4 slices
+    cluster.create_job(hold)
+    controller.sync_job(hold.key())
+    assert len(bound(cluster, "dj-hold")) == 4
+
+    hi = sched_job("dj-hi", workers=4, priority="high")  # same shape: blocked
+    cluster.create_job(hi)
+    controller.sync_job(hi.key())
+    assert bound(cluster, "dj-hi") == []
+
+    # Plain-chip low gang (no topology): disjoint from the slice dimension.
+    plain = new_tpujob(worker=2, name="dj-plain")
+    from tf_operator_tpu.api.types import SchedulingSpec
+
+    plain.spec.scheduling = SchedulingSpec(priority_class="low")
+    cluster.create_job(plain)
+    controller.sync_job(plain.key())
+    assert len(bound(cluster, "dj-plain")) == 2
+
+
+def test_preemption_evicts_lower_class_and_requeues():
+    """Graceful preemption end to end: the victim drains through the
+    reconciler with the Preempted condition and requeues (never Fails);
+    the preemptor admits only after the victim's chips are released."""
+    from tf_operator_tpu.api.types import JobConditionType
+    from tf_operator_tpu.runtime import conditions
+    from tf_operator_tpu.utils import metrics
+
+    before = metrics.preemptions.value("batch")
+    cluster, controller, scheduler = make_stack(total_chips=32)
+    lo = sched_job("pr-victim", workers=4, priority="batch", preemptible=True)
+    cluster.create_job(lo)
+    controller.sync_job(lo.key())
+    assert len(bound(cluster, "pr-victim")) == 4
+
+    hi = sched_job("pr-hi", workers=4, priority="high")
+    cluster.create_job(hi)
+    controller.sync_job(hi.key())
+    # Eviction + release + admission are synchronous on the in-memory
+    # substrate: the preemptor holds the full pool now.
+    assert len(bound(cluster, "pr-hi")) == 4
+    assert metrics.preemptions.value("batch") == before + 1
+
+    # The victim's pods carry the preemption exit protocol.
+    victim_pods = cluster.list_pods(selector={"job-name": "pr-victim"})
+    assert victim_pods and all(
+        p.status.reason == "GangPreempted" for p in victim_pods
+    )
+
+    controller.sync_job(lo.key())  # drain: observe failures, set condition
+    controller.sync_job(lo.key())  # recreate at the back of the queue
+    job = cluster.get_job("default", "pr-victim")
+    assert conditions.has_condition(job.status, JobConditionType.PREEMPTED)
+    assert not conditions.is_failed(job.status)
+    assert bound(cluster, "pr-victim") == []  # waiting, not running
+
+    # Preemptor finishes -> victim re-admits; once it runs again the
+    # Preempted condition retracts (RunningAfterPreemption).
+    finish(cluster, controller, hi)
+    controller.sync_job(lo.key())
+    assert len(bound(cluster, "pr-victim")) == 4
+    for pod in cluster.list_pods(selector={"job-name": "pr-victim"}):
+        cluster.set_pod_phase("default", pod.metadata.name, PodPhase.RUNNING)
+    controller.sync_job(lo.key())
+    job = cluster.get_job("default", "pr-victim")
+    assert not conditions.has_condition(job.status, JobConditionType.PREEMPTED)
+
+
+def test_no_preemption_for_non_preemptible_or_same_class():
+    """Victims must be preemptible AND strictly below the preemptor."""
+    cluster, controller, scheduler = make_stack(total_chips=32)
+    solid = sched_job("np-solid", workers=4, priority="batch",
+                      preemptible=False)
+    cluster.create_job(solid)
+    controller.sync_job(solid.key())
+    assert len(bound(cluster, "np-solid")) == 4
+
+    hi = sched_job("np-hi", workers=4, priority="high")
+    cluster.create_job(hi)
+    controller.sync_job(hi.key())
+    assert bound(cluster, "np-hi") == []  # non-preemptible victim: no evict
+    assert len(bound(cluster, "np-solid")) == 4
+
+    peer = sched_job("np-peer", workers=4, priority="batch", preemptible=True)
+    cluster2, controller2, scheduler2 = make_stack(total_chips=32)
+    cluster2.create_job(peer)
+    controller2.sync_job(peer.key())
+    same = sched_job("np-same", workers=4, priority="batch")
+    cluster2.create_job(same)
+    controller2.sync_job(same.key())
+    assert bound(cluster2, "np-same") == []  # same class never evicts
+    assert len(bound(cluster2, "np-peer")) == 4
+
+
+def test_weighted_fair_share_across_tenants():
+    """Within a class, admission interleaves tenants toward their weights:
+    with weights a:3 b:1 and room for four equal gangs, a gets 3, b gets 1,
+    and the published dominant shares converge (equal weighted share)."""
+    from tf_operator_tpu.controller.controller import TPUJobController
+    from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+    from tf_operator_tpu.utils import metrics
+
+    cluster = InMemoryCluster()
+    controller = TPUJobController(
+        cluster, config=ReconcilerConfig(enable_gang_scheduling=True)
+    )
+    scheduler = GangScheduler(
+        cluster, total_chips=32, tenant_weights={"ten-a": 3.0, "ten-b": 1.0}
+    )
+    hold = tpu_job("fs-hold", workers=4)
+    cluster.create_job(hold)
+    controller.sync_job(hold.key())
+
+    jobs = []
+    for i in range(4):
+        for tenant in ("ten-a", "ten-b"):
+            j = sched_job(f"fs-{tenant[-1]}{i}", workers=1, tenant=tenant)
+            jobs.append(j)
+            cluster.create_job(j)
+            controller.sync_job(j.key())
+    assert all(bound(cluster, j.metadata.name) == [] for j in jobs)
+
+    finish(cluster, controller, hold)
+    admitted = [j.metadata.name for j in jobs if bound(cluster, j.metadata.name)]
+    a_count = sum(1 for n in admitted if "-a" in n)
+    b_count = sum(1 for n in admitted if "-b" in n)
+    assert (a_count, b_count) == (3, 1), admitted
+    share_a = metrics.tenant_dominant_share.value("ten-a")
+    share_b = metrics.tenant_dominant_share.value("ten-b")
+    assert abs(share_a - share_b) < 1e-9  # equal weighted shares
+
+
+def test_warned_marks_bounded_and_cleared_on_repair(monkeypatch):
+    """The unsatisfiable-shape marker set is bounded and is cleared when the
+    fabric reports a slice of the shape repaired (shape exists again)."""
+    from tf_operator_tpu.controller.controller import TPUJobController
+    from tf_operator_tpu.runtime import scheduler as sched_mod
+    from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+    from tf_operator_tpu.runtime.slices import FakeSliceProvider
+
+    monkeypatch.setattr(sched_mod, "MAX_WARNED_MARKS", 3)
+    cluster = InMemoryCluster()
+    controller = TPUJobController(
+        cluster, config=ReconcilerConfig(enable_gang_scheduling=True)
+    )
+    provider = FakeSliceProvider({("v5litepod-16", "2x8"): 1})
+    scheduler = GangScheduler(cluster, slice_provider=provider)
+
+    # Five gangs of a shape the fabric does not have at all.
+    for i in range(5):
+        job = tpu_job(f"bad-{i}", workers=2)  # v5litepod/2x4: not in inventory
+        cluster.create_job(job)
+        controller.sync_job(job.key())
+    with scheduler._lock:
+        assert 0 < len(scheduler._warned) <= 3  # bounded, oldest evicted
+
+    # A repaired slice of a shape clears that shape's marks.
+    slc = provider.list_slices()[0]
+    with scheduler._lock:
+        scheduler._warned[("default/x", slc.accelerator, slc.topology)] = True
+    provider.inject_preemption(slc.id)
+    provider.repair(slc.id)
+    with scheduler._lock:
+        assert ("default/x", slc.accelerator, slc.topology) not in scheduler._warned
+
+    # Departure clears the departed gang's marks.
+    with scheduler._lock:
+        remaining = [m[0] for m in scheduler._warned]
+    for key in remaining:
+        name = key.split("/", 1)[1]
+        for pod in cluster.list_pods(selector={"job-name": name}):
+            cluster.delete_pod("default", pod.metadata.name)
+    with scheduler._lock:
+        assert not any(m[0] in remaining for m in scheduler._warned)
+
+
+class TestPolicyFunctions:
+    def test_select_victims_lowest_class_youngest_first(self):
+        from tf_operator_tpu.runtime import policy
+
+        def gang(key, rank, created, chips, preemptible=True):
+            return policy.GangRequest(
+                key=key, namespace="default",
+                policy=policy.GangPolicy(
+                    priority_class="x", rank=rank, tenant="t",
+                    preemptible=preemptible),
+                dims={policy.CHIPS: chips}, created=(created, key))
+
+        admitted = [
+            gang("old-low", 0, 1.0, 8),
+            gang("young-low", 0, 9.0, 8),
+            gang("mid", 1, 5.0, 8),
+            gang("peer", 2, 2.0, 8),          # preemptor's class: untouchable
+            gang("pinned", 0, 3.0, 8, False),  # not preemptible
+        ]
+        victims = policy.select_victims({policy.CHIPS: 16}, 2, admitted)
+        assert [v.key for v in victims] == ["young-low", "old-low"]
+
+    def test_select_victims_hopeless_evicts_nobody(self):
+        from tf_operator_tpu.runtime import policy
+
+        admitted = [policy.GangRequest(
+            key="only", namespace="default",
+            policy=policy.GangPolicy(
+                priority_class="low", rank=0, tenant="t", preemptible=True),
+            dims={policy.CHIPS: 8}, created=(1.0, "only"))]
+        assert policy.select_victims({policy.CHIPS: 64}, 3, admitted) is None
+
+    def test_may_backfill_rules(self):
+        from tf_operator_tpu.runtime import policy
+
+        blocked = [{policy.CHIPS: 32}]
+        assert not policy.may_backfill({policy.CHIPS: 8}, blocked,
+                                       {policy.CHIPS: 8})
+        # disjoint dimensions never delay the blocked gang
+        assert policy.may_backfill({("v5e", "2x4"): 1}, blocked,
+                                   {policy.CHIPS: 8, ("v5e", "2x4"): 1})
+        # unlimited dimension (absent from free) never blocks
+        assert policy.may_backfill({policy.CHIPS: 8}, blocked, {})
+
+    def test_jain_index(self):
+        from tf_operator_tpu.runtime.policy import jain_index
+
+        assert jain_index([1, 1, 1, 1]) == pytest.approx(1.0)
+        assert jain_index([1, 0, 0, 0]) == pytest.approx(0.25)
+        assert jain_index([]) == 1.0

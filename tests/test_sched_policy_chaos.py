@@ -1,0 +1,297 @@
+"""Scheduling-policy chaos: mixed-priority load + faults + replica kill.
+
+The ISSUE 20 acceptance scenario at tier-1 scale: a 2-replica controller
+fleet drives a mixed load — preemptible low/batch gangs from two tenants
+saturating the chip pool, plus pool-scale high-class gangs that must
+preempt their way in — through a seeded fault schedule at the
+ClusterInterface boundary, with one controller replica crash-killed
+mid-soak (no lease release, no graceful handoff).
+
+Invariants sampled THROUGHOUT the soak and asserted at drain:
+  - pool accounting is exact: pool.used equals the sum of admitted
+    reservations (zero leaked chips), sampled under the scheduler lock;
+  - every live bound pod belongs to an admitted gang (zero doubly-admitted
+    or half-bound gangs);
+  - strict priority: each high-class gang reaches fully-Running while
+    lower-class gangs hold or want the pool (the preemption counter must
+    engage — capacity is saturated by design);
+  - every preempted job requeues — carries the Preempted condition, never
+    Failed — and completes once the high-class gangs release the pool;
+  - zero lost gangs: every job ends Succeeded.
+
+Failure messages embed the seed; the fault trace replays exactly
+(docs/fault-injection.md).
+"""
+import threading
+import time
+
+import pytest
+
+from testutil import new_tpujob
+
+from tf_operator_tpu.api.core import PodPhase
+from tf_operator_tpu.api.types import (
+    JobConditionType,
+    ReplicaType,
+    RestartPolicy,
+    SchedulingSpec,
+    TPUTopology,
+)
+from tf_operator_tpu.controller.controller import TPUJobController
+from tf_operator_tpu.runtime import conditions
+from tf_operator_tpu.runtime.cluster import InMemoryCluster, NotFound
+from tf_operator_tpu.runtime.faults import FaultInjector, FaultPlan, FaultyCluster
+from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+from tf_operator_tpu.runtime.scheduler import GangScheduler
+from tf_operator_tpu.runtime.shardlease import ShardLeaseConfig
+from tf_operator_tpu.utils import metrics
+
+pytestmark = pytest.mark.chaos
+
+SEED = 20260807
+TOTAL_CHIPS = 32  # 4 x 8-chip workers: one big gang == the whole pool
+SHORT_JOBS = 12
+BIG_GANGS = 2
+
+
+def wait_for(predicate, timeout=60.0, interval=0.02):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+def short_job(i):
+    """One preemptible 8-chip worker, low/batch class, tenant a/b mix."""
+    job = new_tpujob(worker=1, name=f"short-{i:02d}",
+                     restart_policy=RestartPolicy.EXIT_CODE)
+    job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+        accelerator="v5litepod", topology="2x4")
+    job.spec.scheduling = SchedulingSpec(
+        priority_class=("low", "batch")[i % 2],
+        tenant=("ten-a", "ten-b")[i % 2],
+        preemptible=True,
+    )
+    return job
+
+
+def big_job(i):
+    """A pool-scale high-class gang: admission requires preemption while
+    the shorts saturate the pool."""
+    job = new_tpujob(worker=4, name=f"big-{i}",
+                     restart_policy=RestartPolicy.EXIT_CODE)
+    job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+        accelerator="v5litepod", topology="2x4")
+    job.spec.scheduling = SchedulingSpec(priority_class="high")
+    return job
+
+
+def start_running_kubelet(inner, interval=0.02):
+    """Promote Pending pods to Running and leave them there — the soak
+    controls completion explicitly so the pool stays saturated."""
+    stop_event = threading.Event()
+
+    def loop():
+        while not stop_event.is_set():
+            for pod in inner.list_pods():
+                try:
+                    if pod.status.phase == PodPhase.PENDING:
+                        inner.set_pod_phase("default", pod.metadata.name,
+                                            PodPhase.RUNNING)
+                except Exception:  # deleted between snapshot and write
+                    continue
+            stop_event.wait(interval)
+
+    thread = threading.Thread(target=loop, daemon=True,
+                              name="sched-policy-kubelet")
+    thread.start()
+
+    def stop():
+        stop_event.set()
+        thread.join(timeout=5)
+
+    return stop
+
+
+def complete(inner, name):
+    """Succeed every live pod of `name` (releases its reservation)."""
+    for pod in inner.list_pods(selector={"job-name": name}):
+        if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+            continue
+        try:
+            inner.set_pod_phase("default", pod.metadata.name,
+                                PodPhase.SUCCEEDED, exit_code=0)
+        except NotFound:
+            continue
+
+
+def fully_running(inner, name, workers):
+    pods = [p for p in inner.list_pods(selector={"job-name": name})
+            if p.status.phase == PodPhase.RUNNING
+            and p.metadata.annotations.get("tpu-operator.dev/bound") == "true"]
+    return len(pods) == workers
+
+
+class SoakProbe:
+    """Invariant sampler run inside every wait loop."""
+
+    def __init__(self, inner, scheduler, ctx):
+        self.inner = inner
+        self.scheduler = scheduler
+        self.ctx = ctx
+        self.preempted_ever = set()
+
+    def __call__(self):
+        from tf_operator_tpu.api import constants
+
+        with self.scheduler._lock:
+            admitted = dict(self.scheduler._admitted)
+            used = self.scheduler.pool.used
+        assert used == sum(admitted.values()), (
+            f"leaked pool chips: used={used} admitted={admitted} {self.ctx}")
+        assert used <= TOTAL_CHIPS, (admitted, self.ctx)
+        for pod in self.inner.list_pods():
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            if pod.metadata.annotations.get("tpu-operator.dev/bound") != "true":
+                continue
+            group = pod.metadata.annotations.get(
+                constants.GANG_GROUP_ANNOTATION)
+            assert f"default/{group}" in admitted, (
+                f"bound pod {pod.metadata.name} of non-admitted gang "
+                f"{group} {self.ctx}")
+        for job in self.inner.list_jobs():
+            if conditions.has_condition(job.status,
+                                        JobConditionType.PREEMPTED):
+                self.preempted_ever.add(job.metadata.name)
+            if job.metadata.name in self.preempted_ever:
+                assert not conditions.is_failed(job.status), (
+                    f"preempted job {job.metadata.name} Failed — preemption "
+                    f"must requeue, never Fail {self.ctx}")
+
+
+def test_mixed_load_soak_with_replica_kill():
+    injector = FaultInjector(FaultPlan(seed=SEED, rate=0.15,
+                                       latency_range=(0.0, 0.005)))
+    inner = InMemoryCluster()
+    faulty = FaultyCluster(inner, injector)
+    ctx = f"(seed={SEED})"
+    preemptions_before = sum(
+        metrics.preemptions.value(c) for c in ("low", "batch"))
+
+    # Shared scheduler on the raw substrate; the fleet reconciles through
+    # the faulted boundary.  A shared scheduler must not be gated on any
+    # single replica's shard split, so ownership is preset wide open —
+    # the controller's gang_scheduler setter is first-adopter-only and
+    # leaves an explicitly configured gate alone.
+    scheduler = GangScheduler(
+        inner, total_chips=TOTAL_CHIPS,
+        tenant_weights={"ten-a": 2.0, "ten-b": 1.0})
+    scheduler.owns_gang = lambda key: True
+    fleet = [
+        TPUJobController(
+            faulty,
+            config=ReconcilerConfig(enable_gang_scheduling=True,
+                                    reconciler_sync_loop_period=0.1),
+            threadiness=1,
+            shards=4,
+            shard_lease=ShardLeaseConfig(lease_duration=0.8,
+                                         renew_period=0.1),
+            identity=f"replica-{i}",
+        )
+        for i in range(2)
+    ]
+    for c in fleet:
+        c.gang_scheduler = scheduler
+    probe = SoakProbe(inner, scheduler, ctx)
+
+    def settled(pred):
+        def check():
+            probe()
+            return pred()
+        return check
+
+    for c in fleet:
+        c.start()
+    stop_kubelet = start_running_kubelet(inner)
+    try:
+        # Phase 1: shorts saturate the pool; the surplus queues.
+        for i in range(SHORT_JOBS // 2):
+            inner.create_job(short_job(i))
+        assert wait_for(settled(
+            lambda: scheduler.pool.used == TOTAL_CHIPS), timeout=60), (
+            f"shorts never saturated the pool {ctx}\n{injector.describe()}")
+
+        # Phase 2: a high-class pool-scale gang arrives — strict priority
+        # demands it preempt its way to fully-Running.
+        inner.create_job(big_job(0))
+        assert wait_for(settled(
+            lambda: fully_running(inner, "big-0", 4)), timeout=60), (
+            f"big-0 never preempted its way in {ctx}\n{injector.describe()}")
+        preemptions_now = sum(
+            metrics.preemptions.value(c) for c in ("low", "batch"))
+        assert preemptions_now > preemptions_before, (
+            f"pool was saturated yet nothing was preempted {ctx}")
+
+        # Phase 3: mid-soak crash-kill one replica (no lease release) while
+        # more load lands.
+        victim = fleet[0]
+        victim.shard_manager.stop(release=False)
+        victim.stop()
+        survivor = fleet[1]
+        for i in range(SHORT_JOBS // 2, SHORT_JOBS):
+            inner.create_job(short_job(i))
+
+        # Phase 4: big-0 completes; the next high gang repeats the cycle
+        # against the surviving replica.
+        complete(inner, "big-0")
+        assert wait_for(settled(
+            lambda: scheduler.pool.used == TOTAL_CHIPS), timeout=60), (
+            f"requeued shorts never re-admitted {ctx}\n{injector.describe()}")
+        inner.create_job(big_job(1))
+        assert wait_for(settled(
+            lambda: fully_running(inner, "big-1", 4)), timeout=60), (
+            f"big-1 never admitted after the replica kill {ctx}\n"
+            f"{injector.describe()}")
+        complete(inner, "big-1")
+
+        # Drain: complete shorts in waves as they (re-)admit.
+        def all_shorts_done():
+            probe()
+            done = 0
+            for i in range(SHORT_JOBS):
+                name = f"short-{i:02d}"
+                if conditions.is_succeeded(
+                        inner.get_job("default", name).status):
+                    done += 1
+                    continue
+                if fully_running(inner, name, 1):
+                    complete(inner, name)
+            return done == SHORT_JOBS
+
+        assert wait_for(all_shorts_done, timeout=90), (
+            f"lost gang: shorts stuck "
+            f"{[i for i in range(SHORT_JOBS) if not conditions.is_succeeded(inner.get_job('default', f'short-{i:02d}').status)]} "
+            f"{ctx}\n{injector.describe()}")
+
+        # Quiescent end state: nothing admitted, nothing leaked, every
+        # gang accounted for, every preempted job requeued and finished.
+        assert wait_for(settled(lambda: scheduler.pool.used == 0),
+                        timeout=30), f"chips leaked at drain {ctx}"
+        with scheduler._lock:
+            assert scheduler._admitted == {}, ctx
+            assert scheduler._evicting == {}, ctx
+        assert probe.preempted_ever, (
+            f"soak never observed a Preempted condition {ctx}")
+        for job in inner.list_jobs():
+            assert conditions.is_succeeded(job.status), (
+                f"{job.metadata.name} did not finish {ctx}")
+            assert not conditions.is_failed(job.status), ctx
+        assert survivor.sync_health.quarantine_count() == 0
+        assert injector.trace, "seeded plan injected nothing; rate/seed broken"
+    finally:
+        stop_kubelet()
+        for c in fleet[1:]:
+            c.stop()

@@ -863,6 +863,210 @@ class ElasticResizeScenario(explore.Scenario):
             assert entry["from"] != entry["to"], entry
 
 
+class GangPreemptionScenario(explore.Scenario):
+    """Scheduling-policy preemption racing the victim's own lifecycle
+    (docs/scheduling-policy.md): a preemptible batch gang holds the whole
+    chip pool while four adversaries interleave — the sync loop, the
+    arrival of a high-class preemptor gang (whose admission must evict the
+    victim), a replica kill inside the victim (retryable exit 137), and a
+    spec resize of the victim (4 -> 3 -> 4).  The preemptor's completion
+    racing the victim's requeue is the deterministic epilogue.
+
+    After EVERY sync: pool accounting is exact (pool.used equals the sum
+    of admitted reservations — no leaked or double-counted chips), every
+    bound live pod belongs to an admitted gang (no double-admission, no
+    binding without a reservation), and neither job has transitioned
+    Failed — preemption requeues, it never Fails.  After the schedule:
+    the preemptor ran at full width, and once it completes the victim is
+    re-admitted at full width with its Preempted condition retracted —
+    no gang is ever lost."""
+
+    name = "gang-preemption-vs-victim-races"
+    VICTIM, PREEMPTOR = "pre-victim", "pre-hi"
+    WORKERS, CHIPS = 4, 32  # 4 x 8-chip workers == the whole pool
+
+    def build(self):
+        from tf_operator_tpu.api.defaults import set_defaults
+        from tf_operator_tpu.api.types import (
+            ReplicaType,
+            RestartPolicy,
+            SchedulingSpec,
+            TPUTopology,
+        )
+        from tf_operator_tpu.controller.controller import TPUJobController
+        from tf_operator_tpu.runtime.reconciler import ReconcilerConfig
+        from tf_operator_tpu.runtime.scheduler import GangScheduler
+
+        from testutil import new_tpujob
+
+        cluster = InMemoryCluster()
+        controller = TPUJobController(
+            cluster, config=ReconcilerConfig(enable_gang_scheduling=True))
+        scheduler = GangScheduler(cluster, total_chips=self.CHIPS)
+        controller.gang_scheduler = scheduler  # wires owns_gang gating
+
+        def make(name, priority, preemptible):
+            job = new_tpujob(worker=self.WORKERS, name=name,
+                             restart_policy=RestartPolicy.EXIT_CODE)
+            job.spec.replica_specs[ReplicaType.WORKER].tpu = TPUTopology(
+                accelerator="v5litepod", topology="2x4")  # 8 chips/worker
+            job.spec.scheduling = SchedulingSpec(
+                priority_class=priority, preemptible=preemptible)
+            set_defaults(job)
+            return job
+
+        state = {"cluster": cluster, "controller": controller,
+                 "scheduler": scheduler, "make": make,
+                 "model": locks.new_lock("model")}
+        cluster.create_job(make(self.VICTIM, "batch", True))
+        # Deterministic prologue: the victim admits and runs at full pool
+        # width before the adversaries start.
+        self._sync(state)
+        self._sync(state)
+        assert len(self._bound(state, self.VICTIM)) == self.WORKERS
+        return state
+
+    @classmethod
+    def _bound(cls, state, name):
+        from tf_operator_tpu.api.core import PodPhase
+
+        return [
+            p for p in state["cluster"].list_pods(selector={"job-name": name})
+            if p.metadata.annotations.get("tpu-operator.dev/bound") == "true"
+            and p.status.phase not in (PodPhase.SUCCEEDED, PodPhase.FAILED)
+        ]
+
+    @classmethod
+    def _sync(cls, state) -> None:
+        """One controller pass over both jobs + kubelet stand-in, then the
+        accounting invariants.  requires: model lock held (or the
+        single-threaded build/check phases)."""
+        from tf_operator_tpu.api.core import PodPhase
+
+        for name in (cls.VICTIM, cls.PREEMPTOR):
+            try:
+                state["controller"].sync_job(f"default/{name}")
+            except NotFound:
+                pass
+        for pod in state["cluster"].list_pods():
+            if pod.status.phase == PodPhase.PENDING:
+                state["cluster"].set_pod_phase(
+                    "default", pod.metadata.name, PodPhase.RUNNING)
+        cls._check_accounting(state)
+
+    @classmethod
+    def _check_accounting(cls, state) -> None:
+        from tf_operator_tpu.api import constants
+        from tf_operator_tpu.api.core import PodPhase
+        from tf_operator_tpu.runtime import conditions
+
+        scheduler = state["scheduler"]
+        with scheduler._lock:
+            admitted = dict(scheduler._admitted)
+        assert scheduler.pool.used == sum(admitted.values()), (
+            f"leaked pool chips: used={scheduler.pool.used} != "
+            f"admitted {admitted}")
+        assert scheduler.pool.used <= cls.CHIPS, admitted
+        for pod in state["cluster"].list_pods():
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            if pod.metadata.annotations.get("tpu-operator.dev/bound") != "true":
+                continue
+            group = pod.metadata.annotations.get(
+                constants.GANG_GROUP_ANNOTATION)
+            assert f"default/{group}" in admitted, (
+                f"bound pod {pod.metadata.name} of non-admitted gang {group}")
+        for name in (cls.VICTIM, cls.PREEMPTOR):
+            try:
+                job = state["cluster"].get_job("default", name)
+            except NotFound:
+                continue
+            assert not conditions.is_failed(job.status), (
+                f"{name} transitioned Failed during the preemption race")
+
+    def threads(self, state):
+        from tf_operator_tpu.api.core import PodPhase
+        from tf_operator_tpu.api.types import ReplicaType
+
+        model, cluster = state["model"], state["cluster"]
+
+        def sync_loop():
+            for _ in range(5):
+                with model:
+                    self._sync(state)
+                explore.yield_point()
+
+        def preemptor():
+            with model:
+                cluster.create_job(state["make"](self.PREEMPTOR, "high", False))
+            explore.yield_point()
+            with model:
+                self._sync(state)
+            explore.yield_point()
+
+        def killer():
+            # A retryable in-gang failure (exit 137) racing the eviction:
+            # the reconciler must tell "the fabric killed a replica" apart
+            # from "the scheduler preempted the gang".
+            with model:
+                live = [p for p in cluster.list_pods(
+                            selector={"job-name": self.VICTIM})
+                        if p.status.phase == PodPhase.RUNNING]
+                if live:
+                    cluster.set_pod_phase(
+                        "default", live[0].metadata.name, PodPhase.FAILED,
+                        exit_code=137)
+            explore.yield_point()
+
+        def resizer():
+            for width in (self.WORKERS - 1, self.WORKERS):
+                with model:
+                    try:
+                        job = cluster.get_job("default", self.VICTIM)
+                    except NotFound:
+                        continue
+                    job.spec.replica_specs[
+                        ReplicaType.WORKER].replicas = width
+                    cluster.update_job(job)
+                explore.yield_point()
+
+        return [
+            ("sync", sync_loop),
+            ("preemptor", preemptor),
+            ("kill", killer),
+            ("resize", resizer),
+        ]
+
+    def check(self, state):
+        from tf_operator_tpu.api.core import PodPhase
+        from tf_operator_tpu.api.types import JobConditionType
+        from tf_operator_tpu.runtime import conditions
+
+        # Deterministic settle: the preemptor must win the pool whatever
+        # the interleaving was.
+        for _ in range(4):
+            self._sync(state)
+        assert len(self._bound(state, self.PREEMPTOR)) == self.WORKERS, (
+            "high-class gang failed to preempt its way in")
+        assert self._bound(state, self.VICTIM) == [], (
+            "victim still bound while the preemptor holds the pool")
+        # Epilogue: preemptor completes; the requeued victim re-admits at
+        # full width and the Preempted condition retracts.
+        for pod in state["cluster"].list_pods(
+                selector={"job-name": self.PREEMPTOR}):
+            state["cluster"].set_pod_phase(
+                "default", pod.metadata.name, PodPhase.SUCCEEDED, exit_code=0)
+        for _ in range(4):
+            self._sync(state)
+        assert len(self._bound(state, self.VICTIM)) == self.WORKERS, (
+            "victim gang lost: not re-admitted after the preemptor finished")
+        job = state["cluster"].get_job("default", self.VICTIM)
+        assert not conditions.is_failed(job.status)
+        assert not conditions.has_condition(
+            job.status, JobConditionType.PREEMPTED), (
+            "Preempted condition not retracted after the victim ran again")
+
+
 # ---------------------------------------------------------------------------
 # drivers
 
@@ -873,6 +1077,7 @@ REAL_CODE_SCENARIOS = [
     QuarantineScenario,
     ShardLeaseScenario,
     ElasticResizeScenario,
+    GangPreemptionScenario,
     # in-package (analysis/scenarios.py): the `--race` CLI's soak target,
     # race-checked here at the full tier-1 budget like everything else
     ElasticResizeRaceScenario,

@@ -216,7 +216,7 @@ def _maximal_job():
     from tf_operator_tpu.api.types import (
         ElasticPolicy, JobCondition, JobConditionType, JobStatus,
         ReplicaSpec, ReplicaStatus, RunPolicy, SchedulingPolicy,
-        SuccessPolicy, TPUJob, TPUJobSpec, TPUTopology)
+        SchedulingSpec, SuccessPolicy, TPUJob, TPUJobSpec, TPUTopology)
 
     container = Container(
         name="tpu", image="my-llm:latest",
@@ -258,6 +258,8 @@ def _maximal_job():
         ),
         success_policy=SuccessPolicy.ALL_WORKERS,
         enable_dynamic_worker=True,
+        scheduling=SchedulingSpec(priority_class="high", tenant="research",
+                                  preemptible=True),
     )
     status = JobStatus(
         conditions=[JobCondition(

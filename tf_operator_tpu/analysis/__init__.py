@@ -243,6 +243,10 @@ CONDITION_STATE_MACHINES = {
         "set": {"JobResizing"},
         "clear": {"RunningResized"},
     },
+    "PREEMPTED": {
+        "set": {"GangPreempted"},
+        "clear": {"RunningAfterPreemption"},
+    },
 }
 
 # Calls the state-machine rule inspects, mapped to the transition verb.

@@ -48,6 +48,13 @@ VOLCANO_GROUP_ANNOTATION = "scheduling.k8s.io/group-name"
 # (InMemoryCluster.bind_pod); the k8s backend signals boundness via
 # spec.nodeName instead (pods/binding subresource).
 ANNOTATION_BOUND = "tpu-operator.dev/bound"
+# Scheduling-policy annotations the reconciler stamps on gang pods from
+# spec.scheduling, read back by the in-process gang scheduler for its
+# policy queue (docs/scheduling-policy.md).  Pods without them schedule
+# as the default class/tenant, non-preemptible.
+ANNOTATION_PRIORITY_CLASS = "scheduling.tpu-operator.dev/priority-class"
+ANNOTATION_TENANT = "scheduling.tpu-operator.dev/tenant"
+ANNOTATION_PREEMPTIBLE = "scheduling.tpu-operator.dev/preemptible"
 
 # --- Slice allocation annotations (no reference analogue: GPU pods are
 # placed individually; TPU slices are allocated whole).  The reconciler

@@ -106,6 +106,17 @@ def set_defaults(job: TPUJob) -> TPUJob:
         sp = spec.run_policy.scheduling_policy
         if sp.min_available is None:
             sp.min_available = total_replicas(job)
+
+    # spec.scheduling stays None when absent (policy-less jobs serialize
+    # byte-identically to pre-policy manifests); a present block has its
+    # empty fields normalized to the documented defaults.
+    if spec.scheduling is not None:
+        from .types import DEFAULT_PRIORITY_CLASS, DEFAULT_TENANT
+
+        if not spec.scheduling.priority_class:
+            spec.scheduling.priority_class = DEFAULT_PRIORITY_CLASS
+        if not spec.scheduling.tenant:
+            spec.scheduling.tenant = DEFAULT_TENANT
     return job
 
 

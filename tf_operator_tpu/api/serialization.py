@@ -32,6 +32,7 @@ from .types import (
     RestartPolicy,
     RunPolicy,
     SchedulingPolicy,
+    SchedulingSpec,
     SuccessPolicy,
     TPUJob,
     TPUJobSpec,
@@ -43,6 +44,18 @@ from .types import (
 # to dict
 
 def job_to_dict(job: TPUJob) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "replicaSpecs": {
+            rt.value: _replica_to_dict(rs)
+            for rt, rs in job.spec.replica_specs.items()
+        },
+        "runPolicy": _run_policy_to_dict(job.spec.run_policy),
+        "successPolicy": job.spec.success_policy.value
+        if job.spec.success_policy is not None else None,
+        "enableDynamicWorker": job.spec.enable_dynamic_worker,
+    }
+    if job.spec.scheduling is not None:
+        spec["scheduling"] = _scheduling_to_dict(job.spec.scheduling)
     return {
         "apiVersion": f"{constants.API_GROUP}/{constants.API_VERSION}",
         "kind": constants.KIND,
@@ -53,17 +66,16 @@ def job_to_dict(job: TPUJob) -> Dict[str, Any]:
             "labels": dict(job.metadata.labels),
             "annotations": dict(job.metadata.annotations),
         },
-        "spec": {
-            "replicaSpecs": {
-                rt.value: _replica_to_dict(rs)
-                for rt, rs in job.spec.replica_specs.items()
-            },
-            "runPolicy": _run_policy_to_dict(job.spec.run_policy),
-            "successPolicy": job.spec.success_policy.value
-            if job.spec.success_policy is not None else None,
-            "enableDynamicWorker": job.spec.enable_dynamic_worker,
-        },
+        "spec": spec,
         "status": status_to_dict(job.status),
+    }
+
+
+def _scheduling_to_dict(s: SchedulingSpec) -> Dict[str, Any]:
+    return {
+        "priorityClass": s.priority_class,
+        "tenant": s.tenant,
+        "preemptible": s.preemptible,
     }
 
 
@@ -197,12 +209,25 @@ def job_from_dict(data: Dict[str, Any]) -> TPUJob:
             run_policy=run_policy,
             success_policy=SuccessPolicy(success) if success is not None else None,
             enable_dynamic_worker=bool(spec_raw.get("enableDynamicWorker", False)),
+            scheduling=_scheduling_from_dict(spec_raw.get("scheduling")),
         ),
     )
     status_raw = data.get("status")
     if status_raw:
         job.status = status_from_dict(status_raw)
     return job
+
+
+def _scheduling_from_dict(data: Optional[Dict[str, Any]]) -> Optional[SchedulingSpec]:
+    if not data:
+        return None
+    from .types import DEFAULT_PRIORITY_CLASS, DEFAULT_TENANT
+
+    return SchedulingSpec(
+        priority_class=data.get("priorityClass") or DEFAULT_PRIORITY_CLASS,
+        tenant=data.get("tenant") or DEFAULT_TENANT,
+        preemptible=bool(data.get("preemptible", False)),
+    )
 
 
 def _replica_from_dict(data: Dict[str, Any]) -> ReplicaSpec:

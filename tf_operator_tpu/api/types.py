@@ -127,6 +127,12 @@ class JobConditionType(str, Enum):
     # False (RunningResized) once the resized gang is running
     # (docs/elasticity.md).
     RESIZING = "Resizing"
+    # No reference analogue: the gang scheduler evicted this job's gang to
+    # make room for a higher-priority gang (docs/scheduling-policy.md).
+    # The drained job re-enters the policy queue at its own priority with
+    # its backoff budget untouched; flipped False (RunningAfterPreemption)
+    # once the gang runs again.
+    PREEMPTED = "Preempted"
 
 
 @dataclass
@@ -181,6 +187,47 @@ class SchedulingPolicy:
 
     min_available: Optional[int] = None
     queue: str = ""
+
+
+# Ordered priority-class table for spec.scheduling.priorityClass, lowest
+# first.  Strict priority: the gang scheduler never admits a class while a
+# feasible higher class waits, and preemption never evicts a gang at or
+# above the preemptor's class (docs/scheduling-policy.md).  Validation
+# rejects names outside this table so a typo cannot silently land a
+# production job in the wrong band.
+PRIORITY_CLASSES = ("low", "batch", "standard", "high", "critical")
+DEFAULT_PRIORITY_CLASS = "standard"
+DEFAULT_TENANT = "default"
+
+
+def priority_rank(priority_class: str) -> int:
+    """Rank of a class in the ordered table (higher = more urgent).
+    Unknown/empty names rank as the default class — annotations written by
+    an older controller must not crash admission."""
+    try:
+        return PRIORITY_CLASSES.index(priority_class)
+    except ValueError:
+        return PRIORITY_CLASSES.index(DEFAULT_PRIORITY_CLASS)
+
+
+@dataclass
+class SchedulingSpec:
+    """Multi-tenant scheduling policy block (spec.scheduling).
+
+    No reference analogue: the reference delegates arbitration to Volcano
+    queues.  Here the in-process gang scheduler arbitrates — strict
+    priority across classes, weighted fair share (dominant chip share)
+    across tenants within a class, FIFO within a tenant
+    (docs/scheduling-policy.md).
+    """
+
+    # Name from PRIORITY_CLASSES; validation rejects anything else.
+    priority_class: str = DEFAULT_PRIORITY_CLASS
+    # Fair-share accounting bucket within a class (a team/user id).
+    tenant: str = DEFAULT_TENANT
+    # Consent to graceful eviction: only preemptible gangs are ever chosen
+    # as victims when a higher class cannot fit.
+    preemptible: bool = False
 
 
 @dataclass
@@ -272,6 +319,9 @@ class TPUJobSpec:
     # Each worker sees a sparse cluster spec (itself + all PS) and workers may
     # be scaled without restarting the job (ref: types.go:61-67).
     enable_dynamic_worker: bool = False
+    # Multi-tenant arbitration knobs; None means the default class/tenant,
+    # not preemptible (identical to a pre-policy job).
+    scheduling: Optional[SchedulingSpec] = None
 
 
 @dataclass

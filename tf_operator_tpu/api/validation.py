@@ -50,6 +50,40 @@ def validate_spec(spec: TPUJobSpec) -> None:
     _validate_singleton(spec, (ReplicaType.CHIEF, ReplicaType.MASTER), "chief/master")
     _validate_singleton(spec, (ReplicaType.EVALUATOR,), "evaluator")
     _validate_multislice(spec)
+    _validate_scheduling(spec)
+
+
+_TENANT_RE = None  # compiled lazily; DNS-label shape like k8s names
+
+
+def _validate_scheduling(spec: TPUJobSpec) -> None:
+    """spec.scheduling: the class must come from the ordered table (a typo
+    must not silently land a job in the default band), and the tenant must
+    be a DNS-label-shaped accounting key — it becomes a metric label value
+    (tpujob_tenant_dominant_share) and a pod annotation."""
+    global _TENANT_RE
+    if spec.scheduling is None:
+        return
+    from .types import PRIORITY_CLASSES
+
+    sched = spec.scheduling
+    if sched.priority_class and sched.priority_class not in PRIORITY_CLASSES:
+        valid = ", ".join(PRIORITY_CLASSES)
+        raise ValidationError(
+            "TPUJobSpec is not valid: unknown scheduling.priorityClass "
+            f"{sched.priority_class!r} (valid, lowest first: {valid})"
+        )
+    if sched.tenant:
+        if _TENANT_RE is None:
+            import re
+
+            _TENANT_RE = re.compile(r"^[a-z0-9]([-a-z0-9]{0,61}[a-z0-9])?$")
+        if not _TENANT_RE.match(sched.tenant):
+            raise ValidationError(
+                "TPUJobSpec is not valid: scheduling.tenant "
+                f"{sched.tenant!r} must be a lowercase DNS label "
+                "(alphanumeric and '-', at most 63 chars)"
+            )
 
 
 def _validate_multislice(spec: TPUJobSpec) -> None:

@@ -393,6 +393,20 @@ class TPUJobController(JobPlugin):
             self.expectations.creation_observed(expectation_key(key, rtype, "pods"))
         elif etype == EventType.DELETED:
             self.expectations.deletion_observed(expectation_key(key, rtype, "pods"))
+        elif etype == EventType.MODIFIED:
+            from ..api.core import PodPhase
+            from ..runtime.reconciler import PREEMPTION_REASONS
+
+            if (
+                pod.status.phase == PodPhase.FAILED
+                and pod.status.reason in PREEMPTION_REASONS
+                and self.owns_key(key)
+            ):
+                # Preemption requeues with a clean slate: the rate-limiter
+                # backoff a job accrued from its own earlier failures must
+                # not delay its return to the policy queue — the eviction
+                # was the scheduler's decision, not another job failure.
+                self.work_queue.forget(key)
         self._mark_active(key)
         self._enqueue(key)
 
@@ -1019,6 +1033,13 @@ class TPUJobController(JobPlugin):
         scheduler's own watcher handles the preemption side by failing the
         slice's pods, which requeues via the pod watch."""
         self._gang_scheduler = scheduler
+        # Shard-ownership gate for the scheduler's admit/evict decisions:
+        # the adopting controller lends its owns_key, so a federated
+        # deployment's scheduler only arbitrates gangs of shards this
+        # replica holds.  First adopter wins — an explicitly configured
+        # gate (e.g. a shared scheduler in tests) is never overwritten.
+        if getattr(scheduler, "owns_gang", True) is None:
+            scheduler.owns_gang = self.owns_key
         provider = getattr(scheduler, "slice_provider", None)
         if provider is not None:
             provider.watch(self._on_slice_repaired)

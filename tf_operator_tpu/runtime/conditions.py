@@ -94,6 +94,11 @@ def update_job_conditions(
         # (reason RunningResized) via clear_condition once the resized gang
         # runs, keeping the transition in the condition list as history.
         _remove_condition(status.conditions, JobConditionType.RUNNING)
+    elif ctype == JobConditionType.PREEMPTED:
+        # A preempted gang is drained the same way a resizing one is; the
+        # reconciler retracts Preempted (reason RunningAfterPreemption) via
+        # clear_condition once the requeued gang runs again.
+        _remove_condition(status.conditions, JobConditionType.RUNNING)
 
     _set_condition(status.conditions, cond)
 

@@ -1,0 +1,253 @@
+"""Scheduling-policy decisions for the gang scheduler.
+
+Pure functions and records — no cluster access, no locks, no clock reads —
+so the admission policy is unit-testable and replayable independently of the
+scheduler's threading (docs/scheduling-policy.md).  The GangScheduler turns
+its pod/slice state into `GangRequest`s and capacity maps, asks this module
+*what order to try* (`policy_order`), *who may jump the queue*
+(`may_backfill`), and *who to evict* (`select_victims`), then executes the
+answers under its own lock.
+
+The queue discipline, in decreasing precedence:
+
+  1. strict priority across classes — a gang never waits behind a
+     lower-class gang (api/types.py PRIORITY_CLASSES, highest rank first);
+  2. weighted fair share across tenants within a class — tenants are
+     served in increasing order of weighted dominant share on chips
+     (DRF collapsed to the one fungible dimension the pool accounts);
+  3. FIFO within a tenant — earliest gang creation first.
+
+Capacity is multi-dimensional for feasibility even though fair share is
+chip-only: a request's `dims` map carries the chip count for plain pods
+plus one whole-slice count per distinct slice shape, and backfill/victim
+arithmetic is done per dimension.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Hashable, List, Mapping, Optional, Sequence, Tuple
+
+# A capacity dimension: the chip pool, or one (accelerator, topology) slice
+# shape.  Values are "how many of that dimension" (chips / whole slices).
+CHIPS = "chips"
+Dim = Hashable
+Dims = Dict[Dim, float]
+
+
+@dataclass(frozen=True)
+class GangPolicy:
+    """The spec.scheduling knobs as they reach the scheduler (annotations)."""
+
+    priority_class: str
+    rank: int
+    tenant: str
+    preemptible: bool
+
+
+@dataclass
+class GangRequest:
+    """One gang, waiting or admitted, as the policy layer sees it."""
+
+    key: str  # "namespace/group-name"
+    namespace: str
+    policy: GangPolicy
+    dims: Dims = field(default_factory=dict)
+    # FIFO position: (earliest member pod creation timestamp, key).  The key
+    # tiebreak makes the order total, so two sweeps over the same state make
+    # the same decisions regardless of pod-list order.
+    created: Tuple[float, str] = (0.0, "")
+
+    @property
+    def rank(self) -> int:
+        return self.policy.rank
+
+    @property
+    def tenant(self) -> str:
+        return self.policy.tenant
+
+    def chips(self) -> float:
+        return float(self.dims.get(CHIPS, 0.0))
+
+
+def tenant_weight(weights: Optional[Mapping[str, float]], tenant: str) -> float:
+    """A tenant's fair-share weight; unknown tenants weigh 1 (never 0 — a
+    zero weight would make the tenant's share infinite and starve it)."""
+    if not weights:
+        return 1.0
+    w = float(weights.get(tenant, 1.0))
+    return w if w > 0 else 1.0
+
+
+def dominant_shares(
+    usage: Mapping[str, float],
+    capacity: Optional[float],
+    weights: Optional[Mapping[str, float]] = None,
+) -> Dict[str, float]:
+    """Per-tenant weighted dominant share on chips.
+
+    `capacity` None (unlimited pool) falls back to total current usage as
+    the denominator — the absolute value is then only meaningful relative
+    to other tenants, which is all ordering and the fairness index need.
+    """
+    denom = capacity if capacity else sum(usage.values())
+    if not denom:
+        denom = 1.0
+    return {
+        t: (chips / denom) / tenant_weight(weights, t)
+        for t, chips in usage.items()
+    }
+
+
+def policy_order(
+    waiting: Sequence[GangRequest],
+    usage: Mapping[str, float],
+    capacity: Optional[float],
+    weights: Optional[Mapping[str, float]] = None,
+) -> List[GangRequest]:
+    """Order waiting gangs by the queue discipline.
+
+    `usage` is chips currently held per tenant (admitted gangs).  Within a
+    class the order is built greedily: pick the head-of-FIFO gang of the
+    tenant with the lowest weighted dominant share, then charge that gang's
+    chips to the tenant *as if admitted* before picking the next — so a
+    burst from one tenant interleaves with other tenants' queues instead of
+    monopolizing the class band.  The hypothetical charges carry across
+    class bands (admission would, too).
+    """
+    denom = capacity if capacity else None
+    charged: Dict[str, float] = dict(usage)
+    ordered: List[GangRequest] = []
+    by_rank: Dict[int, Dict[str, List[GangRequest]]] = {}
+    for req in waiting:
+        by_rank.setdefault(req.rank, {}).setdefault(req.tenant, []).append(req)
+    for rank in sorted(by_rank, reverse=True):
+        queues = by_rank[rank]
+        for fifo in queues.values():
+            fifo.sort(key=lambda r: r.created)
+        remaining = sum(len(q) for q in queues.values())
+        while remaining:
+            def share(tenant: str) -> float:
+                d = denom or sum(charged.values()) or 1.0
+                return (charged.get(tenant, 0.0) / d) / tenant_weight(weights, tenant)
+
+            # min share; FIFO-then-name tiebreak keeps the order total.
+            tenant = min(
+                (t for t, q in queues.items() if q),
+                key=lambda t: (share(t), queues[t][0].created),
+            )
+            req = queues[tenant].pop(0)
+            charged[tenant] = charged.get(tenant, 0.0) + req.chips()
+            ordered.append(req)
+            remaining -= 1
+    return ordered
+
+
+def may_backfill(
+    candidate: Dims,
+    blocked_higher: Sequence[Dims],
+    free: Dims,
+) -> bool:
+    """May `candidate` jump ahead of blocked strictly-higher-class gangs?
+
+    Conservative rule: yes only when admitting the candidate provably
+    cannot delay any blocked gang's *earliest feasible admission* — for
+    every blocked gang H and every dimension d both request, the capacity
+    left after the candidate still covers H in full
+    (free[d] - candidate[d] >= H[d]).  A dimension absent from `free`
+    is unlimited (chip pool with no total) and never blocks.
+
+    This under-approximates (H may also be blocked on a dimension the
+    candidate doesn't touch), trading a little backfill throughput for the
+    guarantee that backfill can never push a higher-class admission back.
+    """
+    for higher in blocked_higher:
+        for dim, want in candidate.items():
+            if want <= 0:
+                continue
+            h_want = float(higher.get(dim, 0.0))
+            if h_want <= 0:
+                continue
+            avail = free.get(dim)
+            if avail is None:
+                continue  # unlimited dimension
+            if float(avail) - float(want) < h_want:
+                return False
+    return True
+
+
+def shortfall(request: Dims, free: Dims) -> Dims:
+    """Per-dimension capacity missing to admit `request` right now.
+    Empty when the request fits.  Unlimited dimensions never fall short."""
+    missing: Dims = {}
+    for dim, want in request.items():
+        if want <= 0:
+            continue
+        avail = free.get(dim)
+        if avail is None:
+            continue
+        gap = float(want) - float(avail)
+        if gap > 0:
+            missing[dim] = gap
+    return missing
+
+
+def select_victims(
+    missing: Dims,
+    preemptor_rank: int,
+    admitted: Sequence[GangRequest],
+) -> Optional[List[GangRequest]]:
+    """Choose admitted gangs to evict so `missing` is covered.
+
+    Candidates must be preemptible and of strictly lower class than the
+    preemptor — equal-class eviction would let two gangs evict each other
+    forever, and "never above the preemptor's class" is the documented
+    contract.  Victims are taken lowest class first, youngest first within
+    a class (the gang with the least sunk work pays), and only gangs that
+    actually reduce the remaining shortfall are taken.  Returns None when
+    even evicting every candidate leaves a dimension short: a hopeless
+    preemption must evict nobody.
+    """
+    remaining = {d: float(v) for d, v in missing.items() if v > 0}
+    if not remaining:
+        return []
+    candidates = [
+        g for g in admitted
+        if g.policy.preemptible and g.rank < preemptor_rank
+    ]
+    # Youngest-first within a class: stable sort by created desc, then rank asc.
+    candidates.sort(key=lambda g: g.created, reverse=True)
+    candidates.sort(key=lambda g: g.rank)
+    victims: List[GangRequest] = []
+    for gang in candidates:
+        if not remaining:
+            break
+        helps = False
+        for dim in list(remaining):
+            freed = float(gang.dims.get(dim, 0.0))
+            if freed <= 0:
+                continue
+            helps = True
+            left = remaining[dim] - freed
+            if left > 0:
+                remaining[dim] = left
+            else:
+                del remaining[dim]
+        if helps:
+            victims.append(gang)
+    if remaining:
+        return None
+    return victims
+
+
+def jain_index(values: Sequence[float]) -> float:
+    """Jain's fairness index over per-tenant (weighted) shares: 1.0 when
+    perfectly even, 1/n when one tenant holds everything.  Used by the
+    BENCH_SCHED_POLICY arm's fairness report."""
+    vals = [float(v) for v in values]
+    if not vals:
+        return 1.0
+    square_of_sum = sum(vals) ** 2
+    sum_of_squares = sum(v * v for v in vals)
+    if sum_of_squares == 0:
+        return 1.0
+    return square_of_sum / (len(vals) * sum_of_squares)

@@ -149,6 +149,17 @@ class ReconcileResult:
 # backoff exemption key off it.
 SLICE_PREEMPTED_REASON = "SlicePreempted"
 
+# Pod failure reason the gang scheduler stamps when it evicts a whole gang
+# to admit a higher-priority one (runtime/scheduler.py _evict_gang,
+# docs/scheduling-policy.md).
+GANG_PREEMPTED_REASON = "GangPreempted"
+
+# Both flavors share the preemption contract: the restart is the operator's
+# (or the fabric's) doing, not the workload's, so it is backoff-exempt and
+# the controller resets the job's rate-limiter state on requeue.  Preempted
+# jobs requeue; they never Fail.
+PREEMPTION_REASONS = frozenset({SLICE_PREEMPTED_REASON, GANG_PREEMPTED_REASON})
+
 # Resize-history entries kept in status.elastic (newest last): enough to
 # audit a burst of preempt/repair cycles without growing status unboundedly.
 ELASTIC_HISTORY_LIMIT = 20
@@ -506,6 +517,23 @@ class JobReconciler:
                 f"TPUJob {job.metadata.name} is running at resize "
                 f"generation {generation}",
             )
+        # Same retract shape for Preempted: once the requeued gang runs
+        # again the condition flips False in place (history keeps the
+        # eviction visible) instead of being removed.
+        if (
+            not restarting_this_pass
+            and conditions.is_running(job.status)
+            and conditions.has_condition(
+                job.status, conditions.JobConditionType.PREEMPTED
+            )
+        ):
+            conditions.clear_condition(
+                job.status,
+                conditions.JobConditionType.PREEMPTED,
+                "RunningAfterPreemption",
+                f"TPUJob {job.metadata.name} is running again after "
+                "gang preemption",
+            )
         if is_elastic(job):
             total_virtual = sum(
                 elastic_bounds(rs)[2]
@@ -743,6 +771,33 @@ class JobReconciler:
                     )
 
             if (
+                pod.status.phase == PodPhase.FAILED
+                and pod.status.reason == GANG_PREEMPTED_REASON
+            ):
+                # The operator itself evicted this gang to admit a
+                # higher-priority one.  The job requeues REGARDLESS of
+                # restartPolicy — failing it would convert a scheduling
+                # decision into a workload failure — and reads Preempted,
+                # not Restarting: the condition is the documented signal
+                # that the drain was a policy action, retracted
+                # (RunningAfterPreemption) once the gang runs again.
+                log.info("requeueing pod %s after gang preemption", pod.metadata.name)
+                delete(pod)
+                restarted = True
+                conditions.update_job_conditions(
+                    job.status,
+                    conditions.JobConditionType.PREEMPTED,
+                    "GangPreempted",
+                    f"TPUJob {job.metadata.name} was preempted for a "
+                    "higher-priority gang; it requeues at its own priority",
+                )
+                metrics.restarted_pods.labels().inc()
+                if rspec.tpu is not None and rspec.tpu.topology:
+                    gang_restart = True
+                update_job_replica_statuses(job.status, rtype, pod)
+                continue
+
+            if (
                 rspec.restart_policy == RestartPolicy.EXIT_CODE
                 and pod.status.phase == PodPhase.FAILED
                 and self.plugin.pod_failed_is_retryable(job, rspec, pod, exit_code)
@@ -856,6 +911,22 @@ class JobReconciler:
                 else constants.GANG_GROUP_ANNOTATION
             )
             pod.metadata.annotations[group_annotation] = job.metadata.name
+            if job.spec.scheduling is not None:
+                # Policy knobs ride to the gang scheduler on annotations so
+                # admission never needs a TPUJob read (the scheduler watches
+                # pods, not jobs).  setdefault keeps a hand-stamped template
+                # authoritative, matching the slice-shape annotations below.
+                sched = job.spec.scheduling
+                pod.metadata.annotations.setdefault(
+                    constants.ANNOTATION_PRIORITY_CLASS, sched.priority_class
+                )
+                pod.metadata.annotations.setdefault(
+                    constants.ANNOTATION_TENANT, sched.tenant
+                )
+                pod.metadata.annotations.setdefault(
+                    constants.ANNOTATION_PREEMPTIBLE,
+                    "true" if sched.preemptible else "false",
+                )
         if rspec.tpu is not None and rspec.tpu.topology:
             # Slice shape for the scheduler's slice-shaped admission
             # (runtime/slices.py); slice id/host written back at admission.
@@ -1158,10 +1229,11 @@ class JobReconciler:
             for pod in filter_for_replica_type(pods, rtype):
                 if pod.status.phase != PodPhase.RUNNING:
                     continue  # (ref: job.go:287-289)
-                if pod.status.reason == SLICE_PREEMPTED_REASON:
-                    # Preemption is the fabric's fault, not the workload's:
-                    # a job riding out preemptions must not share a backoff
-                    # budget with a crash-looping one.
+                if pod.status.reason in PREEMPTION_REASONS:
+                    # Preemption — the fabric's (SlicePreempted) or the
+                    # scheduler's own (GangPreempted) — is not the
+                    # workload's fault: a job riding out preemptions must
+                    # not share a backoff budget with a crash-looping one.
                     continue
                 for cs in pod.status.container_statuses:
                     if cs.exit_code is not None and is_preemption_exit_code(

@@ -21,18 +21,30 @@ free host slots of held slices before allocating fresh slices.
 The pool models the driver-visible fabric (e.g. one v5e-32 = 32 chips).
 `google.com/tpu` container requests (injected by defaults from the replica's
 topology block) are the unit of accounting for plain pods.
+
+Admission order is a policy queue, not pod-scan order (runtime/policy.py,
+docs/scheduling-policy.md): strict priority across classes, weighted fair
+share across tenants within a class, FIFO within a tenant — with
+conservative backfill (a small gang jumps only when it provably cannot
+delay any blocked higher-class gang) and graceful preemption (victims are
+drained through the reconciler with exit 143 / reason "GangPreempted" and
+requeued; the preemptor admits only after the victims' chips and slices
+are verifiably back in the pool).
 """
 from __future__ import annotations
 
 import math
 import threading
-from typing import Dict, List, Optional, Set, Tuple
+from collections import OrderedDict, deque
+from typing import Callable, Deque, Dict, List, Mapping, Optional, Set, Tuple
 
 from ..api import constants
 from ..api.core import Event, Pod
-from ..utils import locks
+from ..api.types import DEFAULT_PRIORITY_CLASS, DEFAULT_TENANT, priority_rank
+from ..utils import clock, locks
 from ..utils import logging as tpulog
 from ..utils import metrics
+from . import policy
 from .cluster import ClusterInterface, EventType, NotFound
 from .slices import (
     Slice,
@@ -46,6 +58,22 @@ log = tpulog.logger_for_key("gang-scheduler")
 
 # pod name -> (namespace, slice id, host rank)
 SlotMap = Dict[str, Tuple[str, str, int]]
+
+# Keep at most this many (gang, shape) unsatisfiable-warning marks: the set
+# is advisory dedup state, and an adversarial churn of doomed gangs must not
+# grow scheduler memory without bound.  Oldest marks are evicted first — the
+# worst case is a repeated Warning event for an ancient gang, not a leak.
+MAX_WARNED_MARKS = 1024
+
+# How a preemption eviction reads on the failed pods.  Mirrors the
+# "SlicePreempted" fabric-preemption protocol (reconciler: retryable exit,
+# backoffLimit-exempt, job requeues instead of failing); exit 143 is
+# SIGTERM's code, the retryable preemption signal (runtime/exit_codes.py).
+GANG_PREEMPTED_REASON = "GangPreempted"
+
+# Queue-wait quantiles exported per priority class, over a rolling window.
+_WAIT_QUANTILES = (0.5, 0.9, 0.99)
+_WAIT_WINDOW = 256
 
 
 def _pod_replica_order(pod: Pod):
@@ -103,11 +131,23 @@ class GangScheduler:
                  total_chips: Optional[float] = None,
                  scheduler_name: str = constants.GANG_SCHEDULER_NAME,
                  slice_provider: Optional[SliceProvider] = None,
-                 retry_interval: float = 30.0) -> None:
+                 retry_interval: float = 30.0,
+                 tenant_weights: Optional[Mapping[str, float]] = None,
+                 owns_gang: Optional[Callable[[str], bool]] = None) -> None:
         self.cluster = cluster
         self.pool = SlicePool(total_chips)
         self.scheduler_name = scheduler_name
         self.slice_provider = slice_provider
+        # Fair-share weights per tenant (policy.policy_order); tenants not
+        # listed weigh 1.  Operator-level config, deliberately NOT part of
+        # spec.scheduling — a job must not set its own weight.
+        self.tenant_weights = dict(tenant_weights) if tenant_weights else {}
+        # Shard-ownership gate for admit/evict decisions in a federated
+        # deployment: when set, the policy sweep only admits (and therefore
+        # only evicts, victims being prior admissions) gangs whose key this
+        # instance owns.  The controller wires its owns_key here when it
+        # adopts a scheduler that has no gate yet.
+        self.owns_gang = owns_gang
         self._stopped = threading.Event()
         # Serializes bind batches across threads (watch dispatch vs the
         # periodic retry sweep).  Binds run outside self._lock by design,
@@ -124,8 +164,31 @@ class GangScheduler:
         # the lock at allocation time so preemption handling never depends
         # on annotation writes that happen after the lock is dropped.
         self._slots: Dict[str, SlotMap] = {}  # guarded-by: _lock
-        # (group key, shape) already warned unsatisfiable
-        self._warned: Set[tuple] = set()  # guarded-by: _lock
+        # (group key, accelerator, topology) already warned unsatisfiable.
+        # Insertion-ordered so the size bound evicts oldest first; entries
+        # clear when the fabric reports a slice of that shape repaired (the
+        # shape exists again) and when the gang departs.
+        self._warned: "OrderedDict[tuple, bool]" = OrderedDict()  # guarded-by: _lock
+        # group key -> policy-layer request recorded at admission, the
+        # ground truth for fair-share usage and victim selection.
+        self._policy_info: Dict[str, policy.GangRequest] = {}  # guarded-by: _lock
+        # victim group key -> preemptor group key, while the victim drains.
+        # Suppresses re-eviction for the same shortfall on every sweep the
+        # drain's own pod events trigger; cleared when the victim departs.
+        self._evicting: Dict[str, str] = {}  # guarded-by: _lock
+        # group key -> clock.now() when first seen waiting (queue-wait metric)
+        self._wait_started: Dict[str, float] = {}  # guarded-by: _lock
+        # priority class -> rolling window of observed queue waits
+        self._wait_samples: Dict[str, Deque[float]] = {}  # guarded-by: _lock
+        # tenants currently exported on the dominant-share gauge, so a
+        # tenant whose gangs all departed reads 0 instead of a stale share
+        self._share_tenants: Set[str] = set()  # guarded-by: _lock
+        # Policy-sweep re-entrancy: evicting a victim dispatches pod events
+        # synchronously, whose departure handling asks for another sweep.
+        # The running sweep absorbs those requests by looping instead of
+        # recursing (guarded-by: _lock).
+        self._sweeping = False
+        self._sweep_again = False
         register = getattr(cluster, "register_gang_scheduler", None)
         if register is not None:
             register(scheduler_name)
@@ -170,7 +233,11 @@ class GangScheduler:
         if etype == EventType.ADDED:
             with self._lock:
                 self._members.setdefault(key, set()).add(pod.metadata.name)
-            self._try_admit(key, pod.metadata.namespace)
+            # Admission goes through the policy sweep, never directly: a
+            # gang completing its member set must still queue behind a
+            # blocked higher-priority gang (strict priority would otherwise
+            # depend on event arrival order).
+            self._retry_waiting()
         elif etype == EventType.DELETED:
             self._handle_departure(key, pod)
         elif etype == EventType.MODIFIED:
@@ -193,6 +260,11 @@ class GangScheduler:
                     chips = self._admitted.pop(key, None)
                     self._members.pop(key, None)
                     self._slots.pop(key, None)
+                    self._policy_info.pop(key, None)
+                    self._evicting.pop(key, None)
+                    self._wait_started.pop(key, None)
+                    for mark in [m for m in self._warned if m[0] == key]:
+                        del self._warned[mark]
                     if chips:
                         self.pool.release(chips)
                         log.info("released %.0f chips from gang %s", chips, key)
@@ -206,12 +278,15 @@ class GangScheduler:
         # Capacity may have freed: retry other waiting gangs.
         self._retry_waiting()
 
-    def _try_admit(self, key: str, namespace: str) -> None:
+    def _try_admit(self, key: str, namespace: str) -> bool:
+        """One admission attempt.  Returns True when the gang holds (or now
+        holds) a reservation, False when it is waiting — the policy sweep
+        uses the verdict to build its blocked-gang set for backfill."""
         group_name = key.split("/", 1)[1]
         try:
             podgroup = self.cluster.get_podgroup(namespace, group_name)
         except NotFound:
-            return  # controller hasn't synced the PodGroup yet; retried on next event
+            return False  # controller hasn't synced the PodGroup yet; retried on next event
         from ..api.core import PodPhase
 
         pods = [
@@ -224,7 +299,8 @@ class GangScheduler:
             admitted = key in self._admitted
         if admitted:
             self._assign_late(key, unbound)
-            return
+            return True
+        request = self._gang_request(key, pods)
         # Atomic check-admit section: the already-admitted check, the chip
         # reservation, and the admitted record must not interleave with a
         # concurrent _try_admit for the same gang (double-reserve would leak
@@ -232,12 +308,13 @@ class GangScheduler:
         # lock — on the k8s backend they are network round-trips.
         assignment: List[tuple] = []
         waiting = False
+        wait_seconds = None
         with self._lock:
             if key in self._admitted:
                 assignment = None  # lost the race; another thread admitted
             else:
                 if len(pods) < podgroup.min_member:
-                    return
+                    return False
                 sliced, plain = self._partition_sliced(pods)
                 chips = sum(pod_chip_request(p) for p in plain)
                 if not self.pool.try_reserve(chips):
@@ -257,18 +334,27 @@ class GangScheduler:
                     else:
                         assignment = granted
                         self._admitted[key] = chips
+                        self._policy_info[key] = request
+                        started = self._wait_started.pop(key, None)
+                        if started is not None:
+                            wait_seconds = max(0.0, clock.now() - started)
         if waiting:
+            with self._lock:
+                self._wait_started.setdefault(key, clock.now())
             self._set_podgroup_phase(podgroup, "Pending")
-            return
+            return False
         if assignment is None:
             self._assign_late(key, unbound)
-            return
+            return True
         # Annotation writes dispatch watch events, so they happen unlocked.
         self._apply_slice_assignment(assignment)
         self._set_podgroup_phase(podgroup, "Running")
         log.info("admitting gang %s (%d pods, %.0f chips)", key, len(pods), chips)
         metrics.admitted_gangs.labels().inc()
+        if wait_seconds is not None:
+            self._observe_wait(request.policy.priority_class, wait_seconds)
         self._bind_all(unbound)
+        return True
 
     # ------------------------------------------------------------------
     # slice-shaped allocation (runtime/slices.py; no reference analogue)
@@ -428,7 +514,9 @@ class GangScheduler:
             mark = (key, accelerator, normalize_topology(topology))
             if mark in self._warned:
                 continue
-            self._warned.add(mark)
+            self._warned[mark] = True
+            while len(self._warned) > MAX_WARNED_MARKS:
+                self._warned.popitem(last=False)
             self.cluster.record_event(Event(
                 object_kind="TPUJob",
                 object_name=group_name,
@@ -467,6 +555,13 @@ class GangScheduler:
                     ]
                     for name in stale:
                         del slot_map[name]
+                # The fabric proved a slice of this shape exists again, so
+                # every "can never be satisfied" verdict for the shape is
+                # stale: drop the marks so the next failed admission of
+                # those gangs re-evaluates (and re-warns if still true).
+                shape = (slc.accelerator, normalize_topology(slc.topology))
+                for mark in [m for m in self._warned if (m[1], m[2]) == shape]:
+                    del self._warned[mark]
             self._retry_waiting()
             return
         if event != "preempted" or slc.holder is None:
@@ -589,19 +684,315 @@ class GangScheduler:
                         pod.metadata.name, exc)
 
     def _retry_waiting(self) -> None:
-        """Retry admission for every gang with unbound pods — waiting gangs
-        get a full admission attempt; admitted gangs get their Pending late
-        members (re)assigned (e.g. after a slice repair)."""
-        namespaces = {}
-        for pod in self.cluster.list_pods():
-            key = self._group_key(pod)
-            if key is None or pod.spec.scheduler_name != self.scheduler_name:
-                continue
-            if self._is_bound(pod):
-                continue
-            namespaces[key] = pod.metadata.namespace
+        """Run the policy sweep, absorbing re-entrant requests.
+
+        Every capacity or membership change funnels here.  Evicting a
+        victim (and admitting a gang) dispatches pod events synchronously
+        on the in-memory substrate, and those events' departure handling
+        asks for another sweep — the running sweep absorbs the request by
+        looping instead of recursing (recursion would both overflow on
+        large drains and re-evict for a shortfall already being drained).
+        """
         with self._lock:
-            waiting = sum(1 for key in namespaces if key not in self._admitted)
-        metrics.waiting_gangs.labels().set(waiting)
-        for key, namespace in namespaces.items():
-            self._try_admit(key, namespace)
+            if self._sweeping:
+                self._sweep_again = True
+                return
+            self._sweeping = True
+        try:
+            while True:
+                self._sweep_once()
+                with self._lock:
+                    if not self._sweep_again:
+                        break
+                    self._sweep_again = False
+        finally:
+            with self._lock:
+                self._sweeping = False
+                self._sweep_again = False
+
+    def _sweep_once(self) -> None:
+        """One deterministic pass over every gang with unbound pods.
+
+        Deterministic by construction: candidates are rebuilt from a pod
+        snapshot and ordered by the policy queue (class rank, then weighted
+        fair share, then earliest gang creation, then key) — never by
+        pod-list scan order, so two sweeps over the same state attempt the
+        same admissions in the same order regardless of how the list is
+        returned.  Admitted gangs with Pending members get late assignment
+        first (they hold reservations already, so they cannot take anything
+        a queued gang is owed); waiting gangs then get admission attempts
+        in policy order with conservative backfill; finally the
+        highest-priority blocked gang may trigger one eviction round.
+        """
+        from ..api.core import PodPhase
+
+        pods_by_key: Dict[str, List[Pod]] = {}
+        for pod in self.cluster.list_pods():
+            if pod.spec.scheduler_name != self.scheduler_name:
+                continue
+            key = self._group_key(pod)
+            if key is None:
+                continue
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            pods_by_key.setdefault(key, []).append(pod)
+        with self._lock:
+            admitted_keys = set(self._admitted)
+            usage: Dict[str, float] = {}
+            for k in admitted_keys:
+                info = self._policy_info.get(k)
+                if info is not None:
+                    usage[info.tenant] = usage.get(info.tenant, 0.0) + info.chips()
+        for key in sorted(k for k in pods_by_key if k in admitted_keys):
+            unbound = [p for p in pods_by_key[key] if not self._is_bound(p)]
+            if unbound:
+                self._assign_late(key, unbound)
+        owns = self.owns_gang
+        requests = [
+            self._gang_request(key, pods)
+            for key, pods in pods_by_key.items()
+            if key not in admitted_keys
+            and any(not self._is_bound(p) for p in pods)
+            and (owns is None or owns(key))
+        ]
+        metrics.waiting_gangs.labels().set(len(requests))
+        ordered = policy.policy_order(
+            requests, usage, self.pool.total, self.tenant_weights
+        )
+        now = clock.now()
+        with self._lock:
+            for req in ordered:
+                self._wait_started.setdefault(req.key, now)
+        blocked: List[policy.GangRequest] = []
+        preemptor: Optional[policy.GangRequest] = None
+        for req in ordered:
+            higher = [b.dims for b in blocked if b.rank > req.rank]
+            if higher and not policy.may_backfill(
+                req.dims, higher, self._free_dims(ordered)
+            ):
+                # Jumping could delay a blocked higher-class gang's earliest
+                # feasible admission: the candidate queues behind instead.
+                blocked.append(req)
+                continue
+            if self._try_admit(req.key, req.namespace):
+                continue
+            if self._is_unsatisfiable(req):
+                # A shape that does not exist in the fabric blocks nobody:
+                # holding backfill (or evicting victims) for it would
+                # deadlock the whole queue behind a gang that can never run.
+                continue
+            blocked.append(req)
+            if preemptor is None:
+                preemptor = req  # highest-priority blocked gang (policy order)
+        if preemptor is not None:
+            self._maybe_preempt(preemptor)
+        self._update_share_gauge()
+
+    # ------------------------------------------------------------------
+    # policy queue plumbing (runtime/policy.py, docs/scheduling-policy.md)
+
+    def _gang_request(self, key: str, pods: List[Pod]) -> policy.GangRequest:
+        """Policy view of a gang from its live pods.  The scheduling knobs
+        ride on pod annotations (stamped by the reconciler from
+        spec.scheduling); pods without them — older controllers, plain
+        manifests — read as the default class/tenant, non-preemptible, so a
+        pre-policy deployment queues exactly as it always has."""
+        cls = DEFAULT_PRIORITY_CLASS
+        tenant = DEFAULT_TENANT
+        preemptible = False
+        for pod in sorted(pods, key=lambda p: p.metadata.name):
+            ann = pod.metadata.annotations
+            if (constants.ANNOTATION_PRIORITY_CLASS in ann
+                    or constants.ANNOTATION_TENANT in ann
+                    or constants.ANNOTATION_PREEMPTIBLE in ann):
+                cls = (ann.get(constants.ANNOTATION_PRIORITY_CLASS)
+                       or DEFAULT_PRIORITY_CLASS)
+                tenant = ann.get(constants.ANNOTATION_TENANT) or DEFAULT_TENANT
+                preemptible = ann.get(constants.ANNOTATION_PREEMPTIBLE) == "true"
+                break
+        dims: policy.Dims = {}
+        sliced, plain = self._partition_sliced(pods)
+        chips = sum(pod_chip_request(p) for p in plain)
+        if chips:
+            dims[policy.CHIPS] = chips
+        # Whole-slice demand per shape, grouped exactly the way
+        # _allocate_slices packs (per replica type), so the feasibility
+        # arithmetic matches what admission will actually request.
+        groups: Dict[tuple, int] = {}
+        for pod in sliced:
+            rtype, accel, topo = _pod_shape(pod)
+            shape = (rtype, accel, normalize_topology(topo))
+            groups[shape] = groups.get(shape, 0) + 1
+        for (_rtype, accel, topo), members in groups.items():
+            hosts = topology_hosts(topo)
+            dim = (accel, topo)
+            dims[dim] = dims.get(dim, 0.0) + float(math.ceil(members / hosts))
+        created = min(
+            (p.metadata.creation_timestamp for p in pods), default=0.0
+        )
+        namespace = pods[0].metadata.namespace if pods else key.split("/", 1)[0]
+        return policy.GangRequest(
+            key=key,
+            namespace=namespace,
+            policy=policy.GangPolicy(
+                priority_class=cls,
+                rank=priority_rank(cls),
+                tenant=tenant,
+                preemptible=preemptible,
+            ),
+            dims=dims,
+            created=(created, key),
+        )
+
+    def _free_dims(self, requests=()) -> policy.Dims:
+        """Currently free capacity per dimension.  The chip dimension is
+        absent when the pool is unlimited (absent == unlimited to the
+        policy layer); slice shapes always get an entry — 0 both when
+        nothing of the shape is free and when the shape does not exist at
+        all — so feasibility arithmetic never mistakes 'none free' for
+        'unlimited'."""
+        free: policy.Dims = {}
+        if self.pool.total is not None:
+            free[policy.CHIPS] = max(0.0, self.pool.total - self.pool.used)
+        if self.slice_provider is not None:
+            for slc in self.slice_provider.list_slices():
+                shape = (slc.accelerator, normalize_topology(slc.topology))
+                free.setdefault(shape, 0.0)
+                if slc.state == SliceState.FREE:
+                    free[shape] += 1.0
+            for req in requests:
+                for dim in req.dims:
+                    if isinstance(dim, tuple):
+                        free.setdefault(dim, 0.0)
+        return free
+
+    def _is_unsatisfiable(self, req: policy.GangRequest) -> bool:
+        """True when the gang waits on a shape the fabric does not have at
+        all (the _warn_unsatisfiable verdict), as opposed to a transient
+        capacity wait.  Such a gang never joins the blocked set."""
+        with self._lock:
+            return any(
+                (req.key, dim[0], dim[1]) in self._warned
+                for dim in req.dims
+                if isinstance(dim, tuple)
+            )
+
+    def _maybe_preempt(self, preemptor: policy.GangRequest) -> None:
+        """Graceful eviction to unblock the highest-priority blocked gang.
+
+        Victims (chosen by policy.select_victims: preemptible, strictly
+        lower class, lowest class first, youngest first) are drained
+        through the reconciler — their pods fail with the preemption exit
+        protocol — and requeue at their own priority.  The preemptor is
+        NOT admitted here: its reservation happens on a later sweep, after
+        the victims' departure verifiably returned their chips and slices
+        to the pool, and the backfill rule keeps lower-class gangs off the
+        freed capacity in the meantime."""
+        missing = policy.shortfall(
+            preemptor.dims, self._free_dims((preemptor,))
+        )
+        if not missing:
+            return  # blocked on membership (gang still assembling), not capacity
+        with self._lock:
+            if preemptor.key in self._evicting.values():
+                return  # a drain for this preemptor is already in flight
+            candidates = [
+                info for k, info in self._policy_info.items()
+                if k in self._admitted and k not in self._evicting
+            ]
+            victims = policy.select_victims(
+                missing, preemptor.rank, candidates)
+            if not victims:
+                # even evicting everything eligible leaves it short:
+                # evict nobody
+                return
+            for victim in victims:
+                self._evicting[victim.key] = preemptor.key
+        for victim in victims:
+            self._evict_gang(victim, preemptor)
+
+    def _evict_gang(self, victim: policy.GangRequest,
+                    preemptor: policy.GangRequest) -> None:
+        """Fail every live pod of the victim gang with the preemption exit
+        protocol: phase Failed, reason GangPreempted, exit 143.  The
+        controller observes the reason, exempts the job's backoff budget,
+        resets its rate-limiter state and requeues it; the departure path
+        here releases the gang's chips and slices once the members drain.
+        Mirrors the fabric's SlicePreempted flow, with the whole gang as
+        the blast radius instead of one slice."""
+        from ..api.core import ContainerStatus, PodPhase
+
+        group_name = victim.key.split("/", 1)[1]
+        log.info(
+            "preempting gang %s (class %s) to admit %s (class %s)",
+            victim.key, victim.policy.priority_class,
+            preemptor.key, preemptor.policy.priority_class,
+        )
+        metrics.preemptions.labels(victim.policy.priority_class).inc()
+        self.cluster.record_event(Event(
+            object_kind="TPUJob",
+            object_name=group_name,
+            namespace=victim.namespace,
+            event_type="Normal",
+            reason=GANG_PREEMPTED_REASON,
+            message=(
+                f"gang evicted for higher-priority gang {preemptor.key} "
+                f"(class {preemptor.policy.priority_class}); the job "
+                "requeues at its own priority with its backoff budget "
+                "untouched"
+            ),
+        ))
+        for pod in self.cluster.list_pods(victim.namespace):
+            if self._group_key(pod) != victim.key:
+                continue
+            if pod.status.phase in (PodPhase.SUCCEEDED, PodPhase.FAILED):
+                continue
+            pod.status.phase = PodPhase.FAILED
+            pod.status.reason = GANG_PREEMPTED_REASON
+            pod.status.message = (
+                f"gang preempted for higher-priority gang {preemptor.key}"
+            )
+            names = [c.name for c in pod.spec.containers] or ["tensorflow"]
+            pod.status.container_statuses = [
+                ContainerStatus(name=n, terminated=True, exit_code=143)
+                for n in names
+            ]
+            try:
+                self.cluster.update_pod_status(pod)
+            except NotFound:
+                continue
+
+    def _observe_wait(self, priority_class: str, seconds: float) -> None:
+        """Fold one admission's queue wait into the per-class rolling
+        window and republish the quantile gauges."""
+        with self._lock:
+            window = self._wait_samples.setdefault(
+                priority_class, deque(maxlen=_WAIT_WINDOW)
+            )
+            window.append(seconds)
+            ordered = sorted(window)
+        for q in _WAIT_QUANTILES:
+            idx = min(len(ordered) - 1, int(q * len(ordered)))
+            metrics.gang_queue_wait.labels(
+                priority_class, str(q)
+            ).set(ordered[idx])
+
+    def _update_share_gauge(self) -> None:
+        """Publish each tenant's weighted dominant share of the pool from
+        the admitted set; tenants whose gangs all departed read 0 rather
+        than their last share."""
+        with self._lock:
+            usage: Dict[str, float] = {}
+            for k in self._admitted:
+                info = self._policy_info.get(k)
+                if info is not None:
+                    usage[info.tenant] = usage.get(info.tenant, 0.0) + info.chips()
+            shares = policy.dominant_shares(
+                usage, self.pool.total, self.tenant_weights
+            )
+            stale = self._share_tenants - set(shares)
+            self._share_tenants = set(shares)
+        for tenant in stale:
+            metrics.tenant_dominant_share.labels(tenant).set(0.0)
+        for tenant, share in shares.items():
+            metrics.tenant_dominant_share.labels(tenant).set(share)

@@ -293,6 +293,31 @@ virtual_replicas = REGISTRY.gauge(
     "steady gang, resizing = group mid-resize)",
     ("state",),
 )
+# Scheduling-policy layer (runtime/policy.py + the gang scheduler's policy
+# queue, docs/scheduling-policy.md): evictions by victim class, queue-wait
+# quantiles by class, and the per-tenant weighted dominant share the
+# fair-share ordering balances.  Strict priority is assertable as "the
+# queue-wait p99 of a higher class never trails a lower class under load";
+# a preemption storm shows in preemptions_total long before job failures
+# would (preempted jobs requeue, they do not Fail).
+preemptions = REGISTRY.counter(
+    "tpujob_preemptions_total",
+    "Gangs evicted by the scheduler to admit a higher-priority gang, "
+    "by the victim's priority class",
+    ("priorityClass",),
+)
+gang_queue_wait = REGISTRY.gauge(
+    "tpujob_gang_queue_wait_seconds",
+    "Gang queue-wait (first seen waiting to admission) quantiles per "
+    "priority class (rolling window)",
+    ("priorityClass", "quantile"),
+)
+tenant_dominant_share = REGISTRY.gauge(
+    "tpujob_tenant_dominant_share",
+    "Weighted dominant share of pool chips held by each tenant's "
+    "admitted gangs",
+    ("tenant",),
+)
 # Shard-lease federation (runtime/shardlease.py, docs/federation.md): how
 # many shard leases each replica currently holds, and the handoff churn.
 # A healthy fleet shows leases_held summing to the shard count with
